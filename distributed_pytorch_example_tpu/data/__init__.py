@@ -1,0 +1,20 @@
+"""Data layer: deterministic sharded sampling, datasets, device feeding.
+
+Reproduces the reference's data contract (reference train.py:53-67,101-116):
+a map-style dataset + a per-replica sharding sampler with per-epoch reshuffle
+(``DistributedSampler.set_epoch`` semantics, train.py:267) — rebuilt for the
+one-process-per-host TPU model, where each host materializes its local slice
+of the *global* batch and the framework assembles a sharded ``jax.Array``.
+"""
+
+from distributed_pytorch_example_tpu.data.sampler import (  # noqa: F401
+    ShardedSampler,
+)
+from distributed_pytorch_example_tpu.data.synthetic import (  # noqa: F401
+    SyntheticClassificationDataset,
+    SyntheticImageDataset,
+    SyntheticTokenDataset,
+)
+from distributed_pytorch_example_tpu.data.loader import (  # noqa: F401
+    DeviceLoader,
+)

@@ -1,0 +1,156 @@
+"""Host→device batch pipeline.
+
+TPU-native replacement for the reference's ``DataLoader(num_workers=2,
+pin_memory=...)`` + ``DistributedSampler`` pair (reference train.py:101-116).
+The shape of the problem differs from torch's (SURVEY.md §7 "Per-host batch
+semantics"): torchrun gives one process per *device*, each loading its own
+shard; JAX gives one process per *host* feeding all local devices. So:
+
+- the dataset is sharded **by process** with :class:`ShardedSampler`
+  (identical determinism contract to ``DistributedSampler``);
+- each step, the host assembles its local slice of the global batch and the
+  loader forms a single global ``jax.Array`` sharded over the mesh's data
+  axes (``jax.make_array_from_process_local_data``), so the jitted train step
+  sees one logical batch regardless of topology;
+- a background thread pre-assembles and pre-transfers the next batches
+  (replaces ``num_workers=2`` + ``pin_memory`` H2D overlap, train.py:112-113).
+
+Static shapes: the final partial batch is padded by wrapping (same spirit as
+``DistributedSampler``'s wrap-padding) so every step has identical shape and
+XLA never recompiles; ``drop_last=True`` drops it instead.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+from distributed_pytorch_example_tpu.data.sampler import ShardedSampler
+from distributed_pytorch_example_tpu.runtime import mesh as mesh_lib
+
+
+def _get_batch(dataset, indices: np.ndarray) -> Dict[str, np.ndarray]:
+    if hasattr(dataset, "get_batch"):
+        return dataset.get_batch(indices)
+    elems = [dataset[int(i)] for i in indices]
+    first = elems[0]
+    if isinstance(first, dict):
+        return {k: np.stack([e[k] for e in elems]) for k in first}
+    # tuple convention (x, y) — the reference's __getitem__ shape (train.py:66-67)
+    return {
+        "x": np.stack([e[0] for e in elems]),
+        "y": np.stack([e[1] for e in elems]),
+    }
+
+
+class DeviceLoader:
+    """Iterates sharded device batches for one process of a multi-host job."""
+
+    def __init__(
+        self,
+        dataset,
+        global_batch_size: int,
+        mesh=None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+        prefetch: int = 2,
+        num_shards: Optional[int] = None,
+        shard_id: Optional[int] = None,
+    ):
+        import jax
+
+        self.dataset = dataset
+        self.mesh = mesh
+        if num_shards is None:
+            num_shards = jax.process_count()
+        if shard_id is None:
+            shard_id = jax.process_index()
+        if global_batch_size % num_shards != 0:
+            raise ValueError(
+                f"global_batch_size {global_batch_size} not divisible by "
+                f"{num_shards} processes"
+            )
+        self.global_batch_size = global_batch_size
+        self.local_batch_size = global_batch_size // num_shards
+        self.sampler = ShardedSampler(
+            len(dataset),
+            num_shards=num_shards,
+            shard_id=shard_id,
+            shuffle=shuffle,
+            seed=seed,
+            drop_last=drop_last,
+        )
+        self.drop_last = drop_last
+        self.prefetch = prefetch
+        if drop_last:
+            self.steps_per_epoch = len(self.sampler) // self.local_batch_size
+        else:
+            self.steps_per_epoch = -(-len(self.sampler) // self.local_batch_size)
+        if self.steps_per_epoch == 0:
+            raise ValueError("Dataset shard smaller than one batch with drop_last")
+        self._sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            axes = mesh_lib.data_axes(mesh)
+            self._sharding = NamedSharding(mesh, PartitionSpec(axes))
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the global shuffle (reference train.py:267 contract)."""
+        self.sampler.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return self.steps_per_epoch
+
+    def _host_batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        indices = self.sampler.shard_indices()
+        n = self.steps_per_epoch * self.local_batch_size
+        if n > len(indices):  # wrap-pad the final partial batch
+            indices = np.concatenate([indices, indices[: n - len(indices)]])
+        for step in range(self.steps_per_epoch):
+            lo = step * self.local_batch_size
+            yield _get_batch(self.dataset, indices[lo : lo + self.local_batch_size])
+
+    def _to_device(self, host_batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        import jax
+
+        if self._sharding is not None:
+            return {
+                k: jax.make_array_from_process_local_data(self._sharding, v)
+                for k, v in host_batch.items()
+            }
+        return {k: jax.device_put(v) for k, v in host_batch.items()}
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        if self.prefetch <= 0:
+            for hb in self._host_batches():
+                yield self._to_device(hb)
+            return
+
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        sentinel = object()
+        err: list = []
+
+        def producer():
+            try:
+                for hb in self._host_batches():
+                    q.put(self._to_device(hb))
+            except BaseException as e:  # surfaced in the consumer
+                err.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        t.join()
+        if err:
+            raise err[0]

@@ -1,0 +1,120 @@
+"""Synthetic datasets — no downloads, instant startup.
+
+Parity target: the reference's ``SyntheticDataset`` of Gaussian features and
+uniform integer labels (reference train.py:53-67), which is what makes its
+single-process smoke mode dependency-free (SURVEY.md §4). Extended with image
+(NHWC, for the ResNet/ViT configs) and token (for BERT/GPT-2 configs)
+variants covering every BASELINE.json workload.
+
+All datasets are map-style (``__len__`` / ``__getitem__``) and additionally
+expose vectorized ``get_batch(indices) -> dict[str, np.ndarray]`` which the
+loader prefers (one fancy-index instead of a Python loop per element).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+
+class _ArrayDataset:
+    """Map-style dataset backed by parallel NumPy arrays."""
+
+    def __init__(self, arrays: Dict[str, np.ndarray]):
+        lengths = {k: len(v) for k, v in arrays.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"Mismatched array lengths: {lengths}")
+        self.arrays = arrays
+        self._len = next(iter(lengths.values()))
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        return {k: v[idx] for k, v in self.arrays.items()}
+
+    def get_batch(self, indices: Sequence[int]) -> Dict[str, np.ndarray]:
+        idx = np.asarray(indices)
+        return {k: v[idx] for k, v in self.arrays.items()}
+
+
+class SyntheticClassificationDataset(_ArrayDataset):
+    """Gaussian features + uniform labels (reference train.py:53-67 parity).
+
+    Defaults match the reference exactly: 10,000 samples, 784 features,
+    10 classes (train.py:55).
+    """
+
+    def __init__(
+        self,
+        num_samples: int = 10000,
+        input_size: int = 784,
+        num_classes: int = 10,
+        seed: int = 0,
+        dtype=np.float32,
+    ):
+        rng = np.random.default_rng(seed)
+        super().__init__(
+            {
+                "x": rng.standard_normal((num_samples, input_size), dtype=dtype),
+                "y": rng.integers(0, num_classes, (num_samples,), dtype=np.int32),
+            }
+        )
+        self.num_classes = num_classes
+
+
+class SyntheticImageDataset(_ArrayDataset):
+    """Gaussian NHWC images + labels for the vision configs.
+
+    NHWC is the TPU-native conv layout (XLA's preferred on TPU); the
+    reference's torch pipeline is NCHW but that is a CUDA idiom, not a
+    capability.
+    """
+
+    def __init__(
+        self,
+        num_samples: int = 10000,
+        image_size: int = 32,
+        channels: int = 3,
+        num_classes: int = 10,
+        seed: int = 0,
+        dtype=np.float32,
+    ):
+        rng = np.random.default_rng(seed)
+        super().__init__(
+            {
+                "x": rng.standard_normal(
+                    (num_samples, image_size, image_size, channels), dtype=dtype
+                ),
+                "y": rng.integers(0, num_classes, (num_samples,), dtype=np.int32),
+            }
+        )
+        self.num_classes = num_classes
+
+
+class SyntheticTokenDataset(_ArrayDataset):
+    """Uniform token sequences for the LM configs (BERT MLM / GPT-2).
+
+    Produces ``tokens`` of shape (num_samples, seq_len). Loss-specific
+    processing (MLM masking, next-token shift) happens inside the jitted
+    train step so it runs on-device.
+    """
+
+    def __init__(
+        self,
+        num_samples: int = 10000,
+        seq_len: int = 512,
+        vocab_size: int = 50257,
+        seed: int = 0,
+    ):
+        rng = np.random.default_rng(seed)
+        super().__init__(
+            {
+                "tokens": rng.integers(
+                    0, vocab_size, (num_samples, seq_len), dtype=np.int32
+                ),
+            }
+        )
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len

@@ -1,0 +1,49 @@
+"""Model zoo covering every BASELINE.json workload config.
+
+- ``mlp.SimpleNet``  — 784-256-256-10 MLP, exact parity with the reference
+  model (reference train.py:32-50).
+- ``resnet.ResNet18/50`` — CIFAR-10 / ImageNet vision configs.
+- ``vit.ViTB16``     — ViT-B/16.
+- ``bert.BertBase``  — BERT-base with MLM head.
+- ``gpt2.GPT2``      — GPT-2 124M decoder LM.
+
+All models are flax ``nn.Module``s taking NHWC images or int32 token ids and
+routing attention through ``ops.attention`` so kernel/parallelism dispatch is
+centralized.
+
+``get_model(name, **overrides)`` is the string registry used by the CLI.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from distributed_pytorch_example_tpu.models.mlp import SimpleNet  # noqa: F401
+
+
+def get_model(name: str, **overrides: Any):
+    """Build a model (and its default task kind) by registry name."""
+    name = name.lower().replace("_", "-")
+    if name in ("mlp", "simplenet"):
+        return SimpleNet(**overrides)
+    if name in ("resnet18", "resnet-18"):
+        from distributed_pytorch_example_tpu.models.resnet import ResNet18
+
+        return ResNet18(**overrides)
+    if name in ("resnet50", "resnet-50"):
+        from distributed_pytorch_example_tpu.models.resnet import ResNet50
+
+        return ResNet50(**overrides)
+    if name in ("vit-b16", "vit-b-16", "vit"):
+        from distributed_pytorch_example_tpu.models.vit import ViTB16
+
+        return ViTB16(**overrides)
+    if name in ("bert-base", "bert"):
+        from distributed_pytorch_example_tpu.models.bert import BertBase
+
+        return BertBase(**overrides)
+    if name in ("gpt2", "gpt-2", "gpt2-124m"):
+        from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+
+        return GPT2(**overrides)
+    raise ValueError(f"Unknown model: {name!r}")

@@ -1,0 +1,28 @@
+"""SimpleNet — the reference's smoke-test MLP, exact behavioral parity.
+
+Reference: ``SimpleNet`` at train.py:32-50 — flatten → Linear(784,256) → ReLU
+→ Dropout(0.2) → Linear(256,256) → ReLU → Dropout(0.2) → Linear(256,10).
+Same sizes, same dropout rate, same parameter count (269,322).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SimpleNet(nn.Module):
+    input_size: int = 784
+    hidden_size: int = 256
+    num_classes: int = 10
+    dropout_rate: float = 0.2
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)  # nn.Flatten parity
+        for _ in range(2):
+            x = nn.Dense(self.hidden_size, dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)

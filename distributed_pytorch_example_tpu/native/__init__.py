@@ -1,0 +1,7 @@
+"""Native (C++) components, bound via ctypes with pure-Python fallbacks.
+
+Build with ``make -C distributed_pytorch_example_tpu/native``. Nothing in the
+framework *requires* the native build — every binding has a bit-identical
+Python fallback — mirroring how the reference leans on PyTorch's bundled
+native runtime without authoring native code itself (SURVEY.md §2).
+"""

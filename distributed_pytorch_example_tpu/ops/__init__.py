@@ -1,0 +1,16 @@
+"""Compute ops: attention kernels and their dispatch.
+
+The reference has no attention (its model is an MLP, train.py:32-50); these
+ops exist for the BASELINE.json transformer configs (ViT/BERT/GPT-2) and the
+long-context requirements (ring attention / sequence parallelism). Dispatch
+lives here so models never hard-code a kernel:
+
+- ``attention.dot_product_attention`` — XLA reference path everywhere; on TPU
+  with compatible shapes it routes to the Pallas flash kernel.
+- ``ring_attention.ring_attention``   — blockwise attention over a sharded
+  sequence axis via shard_map + ppermute.
+"""
+
+from distributed_pytorch_example_tpu.ops.attention import (  # noqa: F401
+    dot_product_attention,
+)

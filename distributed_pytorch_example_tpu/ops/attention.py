@@ -1,0 +1,94 @@
+"""Multi-head scaled-dot-product attention with kernel dispatch.
+
+Single entry point for every transformer in the zoo. The XLA path below is
+already strong on TPU (XLA fuses softmax chains and tiles the matmuls onto
+the MXU); the Pallas flash kernel (``ops/pallas/flash_attention.py``) is used
+on TPU when shapes allow, cutting HBM traffic from O(S^2) to O(S).
+
+Layout convention: (batch, seq, heads, head_dim) — "BSNH", the layout that
+keeps the MXU matmuls contiguous and maps cleanly onto sequence sharding.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array],
+    causal: bool,
+    softmax_scale: float,
+) -> jax.Array:
+    """Reference attention in pure XLA ops. q,k,v: (B, S, N, H)."""
+    logits = jnp.einsum("bqnh,bknh->bnqk", q, k) * softmax_scale
+    # Upcast the softmax: bf16 logits lose too much precision in the reduce.
+    logits = logits.astype(jnp.float32)
+    if causal:
+        q_len, k_len = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((q_len, k_len), dtype=bool), k_len - q_len)
+        logits = jnp.where(causal_mask, logits, jnp.finfo(jnp.float32).min)
+    if mask is not None:
+        # mask: broadcastable to (B, N, Q, K); True = attend.
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bnqk,bknh->bqnh", weights.astype(v.dtype), v)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
+) -> jax.Array:
+    """Scaled dot-product attention, (B, S, N, H) in and out.
+
+    Args:
+      mask: optional boolean mask broadcastable to (B, N, Q, K); True=attend.
+      causal: apply a causal mask (decoder LM).
+      use_flash: force (True/False) or auto-select (None) the Pallas kernel.
+    """
+    if softmax_scale is None:
+        softmax_scale = 1.0 / math.sqrt(q.shape[-1])
+
+    if use_flash is None:
+        use_flash = _flash_supported(q, k, v, mask)
+    if use_flash:
+        from distributed_pytorch_example_tpu.ops.pallas import flash_attention
+
+        return flash_attention.flash_attention(
+            q, k, v, causal=causal, softmax_scale=softmax_scale
+        )
+    return _xla_attention(q, k, v, mask, causal, softmax_scale)
+
+
+@functools.lru_cache(maxsize=1)
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _flash_supported(q, k, v, mask) -> bool:
+    """Flash path: TPU only, no custom mask, block-friendly seq lens."""
+    if mask is not None or not _on_tpu():
+        return False
+    seq_q, seq_k, head_dim = q.shape[1], k.shape[1], q.shape[-1]
+    return (
+        seq_q % 128 == 0
+        and seq_k % 128 == 0
+        and head_dim in (64, 128, 256)
+        and q.dtype in (jnp.float32, jnp.bfloat16)
+    )

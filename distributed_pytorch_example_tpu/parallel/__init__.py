@@ -1,0 +1,23 @@
+"""Parallelism layer: partition rules and sharding application.
+
+TPU-native replacement for the reference's DDP wrap (reference train.py:233).
+Instead of wrapping a module and hooking backward for bucketed all-reduce, a
+:class:`Partitioner` assigns a ``PartitionSpec`` to every param / optimizer
+leaf and to the batch; the jitted train step then *is* the distributed
+program — XLA inserts and overlaps the gradient all-reduce that DDP's C++
+reducer performs by hand (SURVEY.md §2 native-dependency table).
+
+Strategies (composable via mesh axes, see runtime/mesh.py):
+- ``data_parallel``  — params/opt replicated, batch on (data, fsdp): the
+  reference's semantics (grads averaged across replicas each step).
+- ``fsdp``           — params/opt sharded on 'fsdp' along each leaf's largest
+  divisible axis (ZeRO-3 style), batch on (data, fsdp).
+- tensor-parallel rules for transformer blocks live in ``partition.py``.
+"""
+
+from distributed_pytorch_example_tpu.parallel.api import (  # noqa: F401
+    Partitioner,
+    data_parallel,
+    fsdp,
+    shard_largest_axis,
+)

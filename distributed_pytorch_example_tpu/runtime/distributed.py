@@ -1,0 +1,183 @@
+"""Multi-host process bootstrap and rendezvous.
+
+TPU-native replacement for the reference's torchrun + gloo process-group setup
+(reference train.py:70-86, entrypoint.sh:24-39). On TPU there is one Python
+process per host; ``jax.distributed.initialize`` replaces
+``dist.init_process_group`` and the c10d TCP rendezvous, and XLA's compiled
+collectives over ICI/DCN replace gloo.
+
+The topology contract is the same env-var split the reference uses (SURVEY.md
+§5 "Config / flag system": flags for science, env for topology):
+
+- ``NF_DISCOVERY_SERVICE`` — headless-service DNS suffix (entrypoint.sh:8).
+- ``REPLICAS``             — number of hosts / processes (entrypoint.sh:19).
+- ``COORDINATOR_PORT``     — rendezvous port (reference ``MASTER_PORT``,
+  entrypoint.sh:5, default 29500).
+- ``PROCESS_ID``           — this host's index; when unset it is derived from
+  the hostname's numeric suffix exactly like ``NODE_RANK=${HOSTNAME##*-}``
+  (entrypoint.sh:25).
+- ``COORDINATOR_ADDRESS``  — full override; when unset it is derived as
+  ``{base}-0.{NF_DISCOVERY_SERVICE}:{port}`` exactly like entrypoint.sh:26-28.
+
+Single-process use requires no env vars at all (parity with the reference's
+``torchrun --nnodes=1`` smoke mode, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+from typing import Optional
+
+from distributed_pytorch_example_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+_initialized = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    """Resolved multi-host topology."""
+
+    num_processes: int
+    process_id: int
+    coordinator_address: Optional[str]  # host:port, None for single-process
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def derive_process_id(hostname: Optional[str] = None) -> int:
+    """Node rank from the hostname's trailing numeric suffix.
+
+    Parity with ``NODE_RANK=${HOSTNAME##*-}`` (reference entrypoint.sh:25):
+    ``worker-3`` → 3. Falls back to 0 when there is no numeric suffix.
+    """
+    hostname = hostname if hostname is not None else socket.gethostname()
+    suffix = hostname.rsplit("-", 1)[-1]
+    return int(suffix) if suffix.isdigit() else 0
+
+
+def derive_coordinator_address(
+    hostname: Optional[str] = None,
+    discovery_service: Optional[str] = None,
+    port: Optional[int] = None,
+) -> str:
+    """Coordinator DNS name from replica-0's stable hostname.
+
+    Parity with ``MASTER_ADDR="${BASE_NAME}-0.${HEADLESS_SERVICE}"``
+    (reference entrypoint.sh:26-28): host ``myjob-3`` with discovery service
+    ``svc`` → ``myjob-0.svc:<port>``. Without a discovery service the bare
+    ``{base}-0`` hostname is used (single-network setups / tests).
+    """
+    hostname = hostname if hostname is not None else socket.gethostname()
+    if discovery_service is None:
+        discovery_service = os.environ.get("NF_DISCOVERY_SERVICE")
+    if port is None:
+        port = int(os.environ.get("COORDINATOR_PORT", os.environ.get("MASTER_PORT", "29500")))
+    base = hostname.rsplit("-", 1)[0] if "-" in hostname else hostname
+    coordinator_host = f"{base}-0"
+    if discovery_service:
+        coordinator_host = f"{coordinator_host}.{discovery_service}"
+    return f"{coordinator_host}:{port}"
+
+
+def resolve_config(env: Optional[dict] = None) -> DistributedConfig:
+    """Resolve topology from the environment (see module docstring)."""
+    env = dict(os.environ) if env is None else env
+    num_processes = int(env.get("NUM_PROCESSES", env.get("REPLICAS", "1")))
+    if num_processes <= 1:
+        return DistributedConfig(1, 0, None)
+
+    process_id = env.get("PROCESS_ID", env.get("NODE_RANK"))
+    if process_id is None:
+        process_id = derive_process_id(env.get("HOSTNAME"))
+    coordinator = env.get("COORDINATOR_ADDRESS", env.get("MASTER_ADDR"))
+    if coordinator is None:
+        coordinator = derive_coordinator_address(
+            hostname=env.get("HOSTNAME"),
+            discovery_service=env.get("NF_DISCOVERY_SERVICE"),
+            port=int(env.get("COORDINATOR_PORT", env.get("MASTER_PORT", "29500"))),
+        )
+    elif ":" not in coordinator:
+        port = env.get("COORDINATOR_PORT", env.get("MASTER_PORT", "29500"))
+        coordinator = f"{coordinator}:{port}"
+    return DistributedConfig(num_processes, int(process_id), coordinator)
+
+
+def initialize(config: Optional[DistributedConfig] = None) -> DistributedConfig:
+    """Join the multi-host job (reference ``setup_distributed``, train.py:70-82).
+
+    No-op for single-process topologies; idempotent.
+    """
+    global _initialized
+    if config is None:
+        config = resolve_config()
+    if _initialized:
+        return config
+    if config.is_distributed:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
+        logger.info(
+            "Initialized distributed runtime: process_id=%d, num_processes=%d, "
+            "coordinator=%s",
+            config.process_id,
+            config.num_processes,
+            config.coordinator_address,
+        )
+    else:
+        logger.info("Single-process mode (no rendezvous needed)")
+    _initialized = True
+    return config
+
+
+def shutdown() -> None:
+    """Tear down the distributed runtime (reference train.py:85-86)."""
+    global _initialized
+    if _initialized:
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # single-process / already down
+            pass
+        _initialized = False
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """True on the host that owns rank-0 duties (checkpoint writes, logs).
+
+    Reference analogue: ``rank == 0`` guards at train.py:253,285,314.
+    """
+    return process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every process reaches this point.
+
+    Reference analogue: ``dist.barrier()`` (train.py:259,310). Implemented as
+    a tiny blocking global collective, which is the idiomatic JAX barrier.
+    """
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

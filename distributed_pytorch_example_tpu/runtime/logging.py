@@ -1,0 +1,71 @@
+"""Process-index-tagged logging.
+
+Parity target: the reference's rank-aware logging (reference train.py:16-29),
+which formats every record as ``... - [Rank %(rank)s] ...`` and injects the
+rank from the ``RANK`` env var via a ``logging.Filter``. The reference attaches
+the filter to a single module logger while using a global format string, so
+records from other libraries lack the field (SURVEY.md §5 notes this quirk).
+
+Here we do it cleanly with a ``logging.setLogRecordFactory`` hook so *every*
+record — from any library — carries the process index, and the tag reflects
+``jax.process_index()`` once the distributed runtime is up (falling back to the
+``PROCESS_ID``/``RANK`` env vars before that, preserving the reference's
+env-contract behavior).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+_FORMAT = "%(asctime)s - %(name)s - %(levelname)s - [Rank %(rank)s] %(message)s"
+
+_configured = False
+
+
+def _current_rank() -> str:
+    """Best-effort process index: live JAX value, else env, else '?'.
+
+    Mirrors reference train.py:24 (``os.environ.get("RANK", "?")``) but
+    prefers the authoritative ``jax.process_index()`` once available.
+    """
+    try:
+        import jax
+
+        # Only query if a backend has already been initialized; asking
+        # process_index() eagerly would trigger backend init from inside a
+        # log call, which we never want.
+        if jax._src.xla_bridge._backends:  # noqa: SLF001
+            return str(jax.process_index())
+    except Exception:
+        pass
+    return os.environ.get("PROCESS_ID", os.environ.get("RANK", "?"))
+
+
+def setup_logging(level: int = logging.INFO, force: bool = False) -> None:
+    """Install the rank-tagged record factory + root handler.
+
+    Safe to call multiple times (idempotent unless ``force``).
+    """
+    global _configured
+    if _configured and not force:
+        return
+
+    old_factory = logging.getLogRecordFactory()
+
+    def record_factory(*args, **kwargs):
+        record = old_factory(*args, **kwargs)
+        if not hasattr(record, "rank"):
+            record.rank = _current_rank()
+        return record
+
+    logging.setLogRecordFactory(record_factory)
+    logging.basicConfig(level=level, format=_FORMAT, force=force)
+    _configured = True
+
+
+def get_logger(name: Optional[str] = None) -> logging.Logger:
+    """Return a logger, ensuring rank-tagged logging is configured."""
+    setup_logging()
+    return logging.getLogger(name)

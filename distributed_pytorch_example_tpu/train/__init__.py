@@ -1,0 +1,26 @@
+"""Training layer: jitted steps, tasks, epoch loop, checkpointing.
+
+TPU-native rebuild of the reference's training-loop layer (reference
+train.py:119-318): the hot loop is ONE compiled XLA program per step (forward,
+backward, compiled gradient all-reduce over the data axes, optimizer update)
+instead of eager ops + DDP hooks, and metrics stay on device until a log
+boundary instead of the per-step ``loss.item()`` sync (train.py:141,
+SURVEY.md §3.2).
+"""
+
+from distributed_pytorch_example_tpu.train.state import TrainState  # noqa: F401
+from distributed_pytorch_example_tpu.train.tasks import (  # noqa: F401
+    CausalLMTask,
+    ClassificationTask,
+    MLMTask,
+)
+from distributed_pytorch_example_tpu.train.step import (  # noqa: F401
+    build_eval_step,
+    build_train_step,
+    init_state,
+)
+from distributed_pytorch_example_tpu.train.checkpoint import (  # noqa: F401
+    load_checkpoint,
+    save_checkpoint,
+)
+from distributed_pytorch_example_tpu.train.loop import Trainer  # noqa: F401

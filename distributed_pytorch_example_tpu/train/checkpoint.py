@@ -1,0 +1,115 @@
+"""Checkpoint save / load with best+latest policy and epoch-level resume.
+
+Parity contract (reference train.py:178-209, 252-308; SURVEY.md §3.4):
+
+- the on-disk checkpoint is a SINGLE-LOGICAL-VIEW of the model — the analogue
+  of the reference's DDP-unwrapped state dict (train.py:181-183). Sharded
+  state (FSDP/TP) is gathered to full arrays before writing, so a checkpoint
+  written at one parallelism config restores at any other;
+- payload = {epoch, state (params + optimizer + mutable model state + rng),
+  loss} — optimizer state included, matching train.py:185-190;
+- host 0 writes, every host reads (train.py:253,256) — but gathering is a
+  collective, so ALL hosts enter :func:`save_checkpoint`;
+- writes are atomic (tmp + rename) so a killed job never leaves a torn
+  ``latest`` checkpoint;
+- resume restarts at the saved epoch (train.py:209,257): step-level state is
+  in ``state.step``, epoch granularity is the loop contract.
+
+Format: flax msgpack serialization of the state-dict pytree. No torch, no
+pickle — portable and introspectable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+
+from distributed_pytorch_example_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+BEST_NAME = "best_model.ckpt"
+LATEST_NAME = "latest_model.ckpt"
+
+
+def _gather_to_host(tree: Any) -> Any:
+    """Full logical (unsharded) numpy view of a possibly-sharded pytree.
+
+    Single-host shardings are assembled locally; multi-host shardings go
+    through a process_allgather collective — so this must be called by every
+    process, symmetric with the reference's all-ranks-read contract.
+    """
+
+    def gather(x):
+        if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+            x = jax.random.key_data(x)  # typed PRNG keys → raw uint32
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(x)
+
+    return jax.tree_util.tree_map(gather, tree)
+
+
+def save_checkpoint(
+    path: str,
+    state: Any,
+    epoch: int,
+    loss: float,
+    extra: Optional[dict] = None,
+) -> None:
+    """Write a single-logical-view checkpoint; host 0 performs the write."""
+    host_state = _gather_to_host(state)
+    if jax.process_index() != 0:
+        return
+    payload = {
+        "epoch": epoch,
+        "loss": float(loss),
+        "state": serialization.to_state_dict(host_state),
+        "extra": extra or {},
+    }
+    blob = serialization.msgpack_serialize(payload)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    logger.info("Checkpoint saved to %s", path)
+
+
+def load_checkpoint(
+    path: str,
+    state_template: Any,
+    shardings: Optional[Any] = None,
+) -> Tuple[Any, int, dict]:
+    """Restore (state, epoch, extra) onto devices, re-sharded per template.
+
+    Every process reads the same file (reference train.py:256: resume runs on
+    ALL ranks before the start barrier). Device placement comes from
+    ``shardings`` when given, else from the template's live shardings.
+    """
+    with open(path, "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    state = serialization.from_state_dict(state_template, payload["state"])
+
+    if shardings is None:
+        shardings = jax.tree_util.tree_map(
+            lambda t: t.sharding if isinstance(t, jax.Array) else None,
+            state_template,
+        )
+
+    def restore_leaf(tmpl, val, sh):
+        if isinstance(tmpl, jax.Array) and jnp.issubdtype(
+            tmpl.dtype, jax.dtypes.prng_key
+        ):
+            val = jax.random.wrap_key_data(jnp.asarray(val))
+        return jax.device_put(val, sh) if sh is not None else val
+
+    state = jax.tree_util.tree_map(restore_leaf, state_template, state, shardings)
+    logger.info("Checkpoint loaded from %s, epoch %s", path, payload["epoch"])
+    return state, int(payload["epoch"]), dict(payload.get("extra", {}))

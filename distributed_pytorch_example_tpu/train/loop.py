@@ -1,0 +1,197 @@
+"""The epoch loop: train → validate → reduce → checkpoint → barrier.
+
+Behavioral parity with the reference's ``main()`` orchestration
+(train.py:212-318), rebuilt for compiled steps:
+
+- per-epoch reshuffle via ``loader.set_epoch`` (train.py:267);
+- rank-0 progress log every N batches (train.py:144-148) — fetching ONLY
+  that step's loss, steps in between stay async (no per-step item() sync);
+- validation on a disjoint shard per process with global-mean metrics
+  (train.py:154-175, 275-277 — here the means are global by construction
+  since metrics are computed on the globally-sharded batch inside jit);
+- host-0 best/latest checkpoints keyed on validation accuracy
+  (train.py:292-308) and epoch-granularity resume (train.py:256-257);
+- cross-process barrier per epoch and around resume (train.py:259,310);
+- epoch / total wall-time logs (train.py:265,283,286,312-316).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import optax
+
+from distributed_pytorch_example_tpu.parallel.api import Partitioner
+from distributed_pytorch_example_tpu.runtime import distributed as dist
+from distributed_pytorch_example_tpu.runtime.logging import get_logger
+from distributed_pytorch_example_tpu.train import checkpoint as ckpt_lib
+from distributed_pytorch_example_tpu.train.metrics import MetricAccumulator
+from distributed_pytorch_example_tpu.train.state import TrainState
+from distributed_pytorch_example_tpu.train.step import (
+    build_eval_step,
+    build_train_step,
+    init_state,
+)
+
+logger = get_logger(__name__)
+
+
+class Trainer:
+    """Binds (model, task, optimizer, partitioner) into a runnable job."""
+
+    def __init__(
+        self,
+        model,
+        task,
+        optimizer: optax.GradientTransformation,
+        partitioner: Optional[Partitioner] = None,
+        checkpoint_dir: Optional[str] = None,
+        log_every: int = 10,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.task = task
+        self.optimizer = optimizer
+        self.partitioner = partitioner
+        self.checkpoint_dir = checkpoint_dir
+        self.log_every = log_every
+        self.seed = seed
+        self.train_step = build_train_step(model, task, optimizer)
+        self.eval_step = build_eval_step(model, task)
+        self.state: Optional[TrainState] = None
+        self.state_shardings = None
+
+    # -- state ------------------------------------------------------------
+
+    def init(self, sample_inputs: Any) -> TrainState:
+        self.state, self.state_shardings = init_state(
+            self.model,
+            self.optimizer,
+            sample_inputs,
+            jax.random.key(self.seed),
+            self.partitioner,
+        )
+        n_params = sum(
+            int(x.size) for x in jax.tree_util.tree_leaves(self.state.params)
+        )
+        logger.info("Model parameters: %s", f"{n_params:,}")
+        return self.state
+
+    def _sample_inputs_from(self, loader) -> Any:
+        batch = next(iter(loader))
+        inputs_key = self.task.batch_keys[0]
+        return batch[inputs_key]
+
+    # -- epochs -----------------------------------------------------------
+
+    def train_epoch(self, loader, epoch: int) -> Dict[str, float]:
+        loader.set_epoch(epoch)
+        acc = MetricAccumulator()
+        num_batches = len(loader)
+        for batch_idx, batch in enumerate(loader):
+            self.state, metrics = self.train_step(self.state, batch)
+            acc.append(metrics)
+            if batch_idx % self.log_every == 0 and dist.is_coordinator():
+                logger.info(
+                    "Epoch %d, Batch %d/%d, Loss: %.4f",
+                    epoch,
+                    batch_idx,
+                    num_batches,
+                    float(metrics["loss"]),
+                )
+        return acc.result()
+
+    def validate(self, loader) -> Dict[str, float]:
+        acc = MetricAccumulator()
+        for batch in loader:
+            acc.append(self.eval_step(self.state, batch))
+        return acc.result()
+
+    # -- full fit ---------------------------------------------------------
+
+    def fit(
+        self,
+        train_loader,
+        val_loader=None,
+        epochs: int = 10,
+        resume: Optional[str] = None,
+    ) -> List[Dict[str, float]]:
+        if self.state is None:
+            self.init(self._sample_inputs_from(train_loader))
+
+        if self.checkpoint_dir and dist.is_coordinator():
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+
+        start_epoch = 0
+        best_accuracy = 0.0
+        if resume and os.path.exists(resume):
+            self.state, saved_epoch, extra = ckpt_lib.load_checkpoint(
+                resume, self.state, self.state_shardings
+            )
+            start_epoch = saved_epoch
+            best_accuracy = float(extra.get("best_accuracy", 0.0))
+        dist.barrier("pre-train")
+
+        history: List[Dict[str, float]] = []
+        start_time = time.time()
+
+        for epoch in range(start_epoch, epochs):
+            epoch_start = time.time()
+            train_metrics = self.train_epoch(train_loader, epoch)
+            val_metrics = self.validate(val_loader) if val_loader is not None else {}
+            epoch_time = time.time() - epoch_start
+
+            record = {
+                "epoch": epoch,
+                "epoch_time": epoch_time,
+                "train_loss": train_metrics.get("loss", float("nan")),
+                "val_loss": val_metrics.get("loss", float("nan")),
+                "val_accuracy": val_metrics.get("accuracy", float("nan")),
+            }
+            history.append(record)
+
+            if dist.is_coordinator():
+                logger.info("Epoch %d completed in %.2fs", epoch, epoch_time)
+                logger.info("  Train Loss: %.4f", record["train_loss"])
+                if val_loader is not None:
+                    logger.info(
+                        "  Val Loss: %.4f, Val Accuracy: %.2f%%",
+                        record["val_loss"],
+                        record["val_accuracy"],
+                    )
+
+            if self.checkpoint_dir:
+                is_best = (
+                    val_loader is not None
+                    and record["val_accuracy"] > best_accuracy
+                )
+                if is_best:
+                    best_accuracy = record["val_accuracy"]
+                extra = {"best_accuracy": best_accuracy}
+                # epoch+1 so resume continues AFTER the finished epoch
+                if is_best:
+                    ckpt_lib.save_checkpoint(
+                        os.path.join(self.checkpoint_dir, ckpt_lib.BEST_NAME),
+                        self.state,
+                        epoch + 1,
+                        record["train_loss"],
+                        extra,
+                    )
+                ckpt_lib.save_checkpoint(
+                    os.path.join(self.checkpoint_dir, ckpt_lib.LATEST_NAME),
+                    self.state,
+                    epoch + 1,
+                    record["train_loss"],
+                    extra,
+                )
+            dist.barrier("epoch-end")
+
+        total_time = time.time() - start_time
+        if dist.is_coordinator():
+            logger.info("Training completed in %.2fs", total_time)
+            if val_loader is not None:
+                logger.info("Best validation accuracy: %.2f%%", best_accuracy)
+        return history

@@ -1,0 +1,39 @@
+"""Async metric accumulation.
+
+The reference pays a host sync every step (``loss.item()``, train.py:141 —
+flagged in SURVEY.md §3.2 as a cost the TPU design must not replicate).
+Here per-step metrics stay on device; the accumulator holds device scalars
+and only materializes floats at a log boundary or epoch end, letting steps
+dispatch ahead of the host.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import numpy as np
+
+
+class MetricAccumulator:
+    """Equal-weight running mean of device-scalar metric dicts."""
+
+    def __init__(self):
+        self._batches: List[Dict[str, jax.Array]] = []
+
+    def append(self, metrics: Dict[str, jax.Array]) -> None:
+        self._batches.append(metrics)
+
+    def __len__(self) -> int:
+        return len(self._batches)
+
+    def result(self) -> Dict[str, float]:
+        """Fetch and average everything accumulated (one host sync)."""
+        if not self._batches:
+            return {}
+        fetched = jax.device_get(self._batches)
+        keys = fetched[0].keys()
+        return {k: float(np.mean([b[k] for b in fetched])) for k in keys}
+
+    def reset(self) -> None:
+        self._batches.clear()

@@ -1,0 +1,24 @@
+"""Train state pytree.
+
+One immutable pytree carrying everything a step mutates — the functional
+equivalent of the reference's (DDP model, optimizer) object pair
+(reference train.py:232-249). Keeping optimizer state and mutable model
+state (batch stats) inside one donated pytree lets XLA update everything
+in-place in a single compiled step.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    step: jax.Array  # scalar int32
+    params: Any
+    opt_state: Any
+    model_state: Any  # mutable collections (e.g. batch_stats); {} if none
+    rng: jax.Array  # PRNG key, folded with `step` each train step

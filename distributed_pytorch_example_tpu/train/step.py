@@ -1,0 +1,104 @@
+"""Jit-compiled train/eval steps and sharded state initialization.
+
+The train step is the whole distributed program: forward, backward, gradient
+all-reduce (inserted by XLA from the batch's data-axis sharding — the
+compiled equivalent of DDP's bucketed backward hooks, reference
+train.py:233,138), optimizer update. The input state is donated so params
+and optimizer moments update in place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_pytorch_example_tpu.parallel.api import Partitioner
+from distributed_pytorch_example_tpu.train.state import TrainState
+
+
+def init_state(
+    model,
+    optimizer: optax.GradientTransformation,
+    sample_inputs: Any,
+    rng: jax.Array,
+    partitioner: Optional[Partitioner] = None,
+) -> Tuple[TrainState, Any]:
+    """Create a TrainState, placed per the partitioner's rules.
+
+    Initialization runs under jit with ``out_shardings`` derived from the
+    partition rules, so large sharded params are *born* sharded — no host
+    materialization of the full model (essential for FSDP/TP configs).
+
+    Returns (state, state_shardings) — shardings are reused by the step jit
+    and by checkpoint restore.
+    """
+
+    def init_fn(rng):
+        rng_params, rng_dropout, rng_state = jax.random.split(rng, 3)
+        variables = dict(
+            model.init(
+                {"params": rng_params, "dropout": rng_dropout},
+                sample_inputs,
+                train=False,
+            )
+        )
+        params = variables.pop("params")
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=optimizer.init(params),
+            model_state=variables,
+            rng=rng_state,
+        )
+
+    if partitioner is None:
+        return jax.jit(init_fn)(rng), None
+    shapes = jax.eval_shape(init_fn, rng)
+    shardings = partitioner.tree_shardings(shapes)
+    state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def build_train_step(model, task, optimizer: optax.GradientTransformation):
+    """One compiled optimization step: (state, batch) -> (state, metrics)."""
+
+    def train_step(state: TrainState, batch):
+        step_rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            loss, metrics, new_ms = task.compute_loss(
+                model, params, state.model_state, batch, step_rng, train=True
+            )
+            return loss, (metrics, new_ms)
+
+        grads, (metrics, new_ms) = jax.grad(loss_fn, has_aux=True)(state.params)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            model_state=new_ms,
+        )
+        return new_state, metrics
+
+    return jax.jit(train_step, donate_argnums=0)
+
+
+def build_eval_step(model, task):
+    """One compiled eval step: (state, batch) -> metrics (no grad, no dropout).
+
+    Reference parity: ``validate`` under ``model.eval()`` + ``no_grad``
+    (train.py:154-175).
+    """
+
+    def eval_step(state: TrainState, batch):
+        _, metrics, _ = task.compute_loss(
+            model, state.params, state.model_state, batch, state.rng, train=False
+        )
+        return metrics
+
+    return jax.jit(eval_step)

@@ -1,0 +1,121 @@
+"""Tasks: loss + metrics definitions binding a model to a batch format.
+
+A task computes ``(loss, metrics, new_model_state)`` from (model, params,
+batch). Everything here runs INSIDE the jitted step — including MLM masking —
+so the host never touches per-step data (contrast with the reference's eager
+loop, train.py:132-141).
+
+Metric semantics parity: loss/accuracy are means over the GLOBAL batch. With
+the batch sharded over the data axes this equals the reference's
+"per-shard metric, then cross-rank mean" reduction (train.py:275-277) when
+shards are equal-sized — which they are, by the sampler's padding contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Metrics = Dict[str, jax.Array]
+
+
+def _apply_model(model, params, model_state, inputs, rng, train: bool):
+    """Run model.apply handling mutable collections + dropout rng."""
+    variables = {"params": params, **(model_state or {})}
+    rngs = {"dropout": rng} if train else {}
+    mutable = list(model_state.keys()) if (train and model_state) else False
+    out = model.apply(variables, inputs, train=train, rngs=rngs, mutable=mutable)
+    if mutable:
+        logits, new_vars = out
+        return logits, dict(new_vars)
+    return out, (model_state or {})
+
+
+class ClassificationTask:
+    """Cross-entropy classification on dict batches {'x', 'y'}.
+
+    Reference parity: CrossEntropyLoss (train.py:250) + top-1 accuracy as a
+    percentage (train.py:169-174).
+    """
+
+    batch_keys = ("x", "y")
+
+    def compute_loss(
+        self, model, params, model_state, batch, rng, *, train: bool
+    ) -> Tuple[jax.Array, Metrics, Any]:
+        logits, new_ms = _apply_model(model, params, model_state, batch["x"], rng, train)
+        labels = batch["y"]
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels
+        ).mean()
+        accuracy = 100.0 * jnp.mean(jnp.argmax(logits, axis=-1) == labels)
+        return loss, {"loss": loss, "accuracy": accuracy}, new_ms
+
+
+class CausalLMTask:
+    """Next-token LM on dict batches {'tokens'} (GPT-2 config).
+
+    The model sees tokens[:, :-1] and predicts tokens[:, 1:].
+    """
+
+    batch_keys = ("tokens",)
+
+    def compute_loss(
+        self, model, params, model_state, batch, rng, *, train: bool
+    ) -> Tuple[jax.Array, Metrics, Any]:
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits, new_ms = _apply_model(model, params, model_state, inputs, rng, train)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), targets
+        ).mean()
+        accuracy = 100.0 * jnp.mean(jnp.argmax(logits, axis=-1) == targets)
+        return loss, {"loss": loss, "accuracy": accuracy}, new_ms
+
+
+class MLMTask:
+    """BERT-style masked-LM on dict batches {'tokens'}.
+
+    On-device BERT masking recipe: select ``mask_rate`` of positions; of
+    those, 80% → [MASK], 10% → random token, 10% → unchanged; loss only on
+    selected positions.
+    """
+
+    batch_keys = ("tokens",)
+
+    def __init__(self, vocab_size: int, mask_token_id: int, mask_rate: float = 0.15):
+        self.vocab_size = vocab_size
+        self.mask_token_id = mask_token_id
+        self.mask_rate = mask_rate
+
+    def compute_loss(
+        self, model, params, model_state, batch, rng, *, train: bool
+    ) -> Tuple[jax.Array, Metrics, Any]:
+        tokens = batch["tokens"]
+        rng_sel, rng_kind, rng_rand, rng_drop = jax.random.split(
+            jax.random.fold_in(rng, 1), 4
+        )
+        selected = jax.random.uniform(rng_sel, tokens.shape) < self.mask_rate
+        kind = jax.random.uniform(rng_kind, tokens.shape)
+        random_tokens = jax.random.randint(
+            rng_rand, tokens.shape, 0, self.vocab_size, dtype=tokens.dtype
+        )
+        masked_inputs = jnp.where(
+            selected & (kind < 0.8),
+            jnp.asarray(self.mask_token_id, tokens.dtype),
+            jnp.where(selected & (kind >= 0.9), random_tokens, tokens),
+        )
+        logits, new_ms = _apply_model(
+            model, params, model_state, masked_inputs, rng_drop, train
+        )
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), tokens
+        )
+        denom = jnp.maximum(selected.sum(), 1)
+        loss = jnp.where(selected, per_tok, 0.0).sum() / denom
+        correct = jnp.where(selected, jnp.argmax(logits, axis=-1) == tokens, False)
+        accuracy = 100.0 * correct.sum() / denom
+        return loss, {"loss": loss, "accuracy": accuracy}, new_ms

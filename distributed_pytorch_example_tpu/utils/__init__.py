@@ -1,0 +1,6 @@
+"""Shared utilities: config/flag handling, pytree helpers."""
+
+from distributed_pytorch_example_tpu.utils.config import (  # noqa: F401
+    add_reference_args,
+    add_framework_args,
+)

@@ -1,0 +1,50 @@
+"""Two-tier configuration, matching the reference's split (SURVEY.md §5):
+
+- **flags for science** — argparse hyperparameters, superset of the
+  reference's CLI (reference train.py:213-221): ``--epochs --batch-size --lr
+  --num-samples --checkpoint-dir --resume``;
+- **env for topology** — ``REPLICAS`` / ``NF_DISCOVERY_SERVICE`` /
+  ``COORDINATOR_PORT`` / ``PROCESS_ID``, consumed by
+  ``runtime.distributed.resolve_config`` (reference entrypoint.sh:5-8 parity).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def add_reference_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """The reference's exact flags and defaults (train.py:214-219)."""
+    parser.add_argument("--epochs", type=int, default=10)
+    parser.add_argument("--batch-size", type=int, default=64,
+                        help="PER-REPLICA batch size (reference semantics); "
+                        "global batch = batch-size * data-parallel size")
+    parser.add_argument("--lr", type=float, default=0.001)
+    parser.add_argument("--num-samples", type=int, default=10000)
+    parser.add_argument("--checkpoint-dir", type=str, default="./checkpoints")
+    parser.add_argument("--resume", type=str, default=None)
+    return parser
+
+
+def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """Extensions beyond the reference: model/dataset selection, mesh shape."""
+    parser.add_argument("--model", type=str, default="mlp",
+                        help="mlp|resnet18|resnet50|vit-b16|bert-base|gpt2")
+    parser.add_argument("--dataset", type=str, default="synthetic",
+                        help="synthetic|synthetic-image|synthetic-tokens|cifar10")
+    parser.add_argument("--seq-len", type=int, default=512)
+    parser.add_argument("--image-size", type=int, default=32)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--log-every", type=int, default=10,
+                        help="batches between rank-0 progress logs "
+                        "(reference train.py:144)")
+    parser.add_argument("--mesh-data", type=int, default=-1)
+    parser.add_argument("--mesh-fsdp", type=int, default=1)
+    parser.add_argument("--mesh-tensor", type=int, default=1)
+    parser.add_argument("--mesh-sequence", type=int, default=1)
+    parser.add_argument("--partition", type=str, default="dp",
+                        help="dp|fsdp|tp (tp uses per-model transformer rules)")
+    parser.add_argument("--dtype", type=str, default="float32",
+                        help="compute dtype: float32|bfloat16")
+    return parser

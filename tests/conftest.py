@@ -1,0 +1,42 @@
+"""Test harness: fake 8-device CPU mesh.
+
+The TPU-native analogue of the reference's "gloo on localhost" test mode
+(SURVEY.md §4): ``--xla_force_host_platform_device_count=8`` gives every test
+an 8-device CPU backend, so all sharding/collective paths (the code DDP would
+exercise via multi-process gloo) run in a single pytest process.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-registers the TPU plugin and overrides
+# jax_platforms; point it back at CPU before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    ds = jax.devices()
+    assert len(ds) == 8, f"expected 8 fake CPU devices, got {ds}"
+    return ds
+
+
+@pytest.fixture()
+def mesh_1d(devices):
+    from distributed_pytorch_example_tpu.runtime import make_mesh
+
+    return make_mesh()
+
+
+@pytest.fixture()
+def mesh_2x2x2(devices):
+    from distributed_pytorch_example_tpu.runtime import MeshSpec, make_mesh
+
+    return make_mesh(MeshSpec(data=2, fsdp=2, tensor=2))

@@ -1,0 +1,92 @@
+"""Runtime: hostname→rank derivation, coordinator DNS, mesh construction.
+
+Covers the launcher contract (reference entrypoint.sh:24-28) that SURVEY.md
+§4 lists as a required unit test.
+"""
+
+import pytest
+
+from distributed_pytorch_example_tpu.runtime import MeshSpec, make_mesh
+from distributed_pytorch_example_tpu.runtime.distributed import (
+    derive_coordinator_address,
+    derive_process_id,
+    resolve_config,
+)
+from distributed_pytorch_example_tpu.runtime.mesh import (
+    data_axes,
+    data_parallel_size,
+)
+
+
+def test_derive_process_id_hostname_suffix():
+    # NODE_RANK=${HOSTNAME##*-} parity (entrypoint.sh:25)
+    assert derive_process_id("trainer-3") == 3
+    assert derive_process_id("my-job-12") == 12
+    assert derive_process_id("nosuffix") == 0
+    assert derive_process_id("trailing-dash-") == 0
+
+
+def test_derive_coordinator_address():
+    # MASTER_ADDR="${BASE_NAME}-0.${HEADLESS_SERVICE}" parity (entrypoint.sh:26-28)
+    addr = derive_coordinator_address(
+        hostname="trainer-3", discovery_service="svc.ns", port=29500
+    )
+    assert addr == "trainer-0.svc.ns:29500"
+    assert (
+        derive_coordinator_address(hostname="job-1", discovery_service=None, port=1234)
+        == "job-0:1234"
+    )
+
+
+def test_resolve_config_single_process_default():
+    cfg = resolve_config(env={})
+    assert cfg.num_processes == 1 and cfg.process_id == 0
+    assert not cfg.is_distributed
+
+
+def test_resolve_config_from_reference_env_contract():
+    # REPLICAS + NF_DISCOVERY_SERVICE + HOSTNAME, as the container sets them
+    # (Dockerfile:13-15, entrypoint.sh:5-8)
+    cfg = resolve_config(
+        env={
+            "REPLICAS": "4",
+            "NF_DISCOVERY_SERVICE": "disc.svc",
+            "HOSTNAME": "worker-2",
+            "MASTER_PORT": "29501",
+        }
+    )
+    assert cfg.num_processes == 4
+    assert cfg.process_id == 2
+    assert cfg.coordinator_address == "worker-0.disc.svc:29501"
+
+
+def test_resolve_config_explicit_overrides():
+    cfg = resolve_config(
+        env={
+            "NUM_PROCESSES": "2",
+            "PROCESS_ID": "1",
+            "COORDINATOR_ADDRESS": "10.0.0.1:9999",
+        }
+    )
+    assert cfg.process_id == 1
+    assert cfg.coordinator_address == "10.0.0.1:9999"
+
+
+def test_mesh_default_all_data(devices):
+    mesh = make_mesh()
+    assert dict(mesh.shape) == {"data": 8, "fsdp": 1, "tensor": 1, "sequence": 1}
+    assert data_parallel_size(mesh) == 8
+
+
+def test_mesh_spec_resolution(devices):
+    mesh = make_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "tensor": 2, "sequence": 1}
+    assert data_axes(mesh) == ("data", "fsdp")
+    assert data_parallel_size(mesh) == 4
+
+
+def test_mesh_spec_errors(devices):
+    with pytest.raises(ValueError):
+        MeshSpec(data=3, fsdp=1).resolve(8)  # not divisible
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, fsdp=-1).resolve(8)  # two unknowns

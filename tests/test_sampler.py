@@ -1,0 +1,97 @@
+"""ShardedSampler: the DistributedSampler determinism contract.
+
+Covers the properties SURVEY.md §4 calls out as untested in the reference:
+set_epoch reshuffle semantics (reference train.py:267), disjoint coverage,
+wrap padding, and cross-host determinism without communication.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_pytorch_example_tpu.data.sampler import (
+    ShardedSampler,
+    permutation,
+)
+
+
+def test_permutation_is_a_permutation():
+    for n in (1, 2, 7, 100, 1000):
+        p = permutation(n, seed=42)
+        assert sorted(p.tolist()) == list(range(n))
+
+
+def test_permutation_deterministic_and_seed_sensitive():
+    assert np.array_equal(permutation(100, 7), permutation(100, 7))
+    assert not np.array_equal(permutation(100, 7), permutation(100, 8))
+
+
+def test_shards_disjoint_and_cover():
+    n, shards = 1000, 4
+    samplers = [
+        ShardedSampler(n, num_shards=shards, shard_id=i, seed=3) for i in range(shards)
+    ]
+    all_indices = np.concatenate([s.shard_indices() for s in samplers])
+    assert len(all_indices) == n  # 1000 divides evenly by 4
+    assert sorted(all_indices.tolist()) == list(range(n))
+
+
+def test_wrap_padding_uneven():
+    # 10 samples over 4 shards → 12 total, wraps the first 2 indices
+    n, shards = 10, 4
+    samplers = [
+        ShardedSampler(n, num_shards=shards, shard_id=i, shuffle=False)
+        for i in range(shards)
+    ]
+    assert all(len(s) == 3 for s in samplers)
+    combined = np.concatenate([s.shard_indices() for s in samplers])
+    assert len(combined) == 12
+    assert set(combined.tolist()) == set(range(10))  # every sample appears
+    counts = np.bincount(combined, minlength=10)
+    assert counts.sum() == 12 and counts.max() == 2  # exactly 2 wrapped
+
+
+def test_drop_last():
+    s = ShardedSampler(10, num_shards=4, shard_id=0, drop_last=True, shuffle=False)
+    assert len(s) == 2
+    combined = np.concatenate(
+        [
+            ShardedSampler(10, 4, i, drop_last=True, shuffle=False).shard_indices()
+            for i in range(4)
+        ]
+    )
+    assert len(combined) == 8 and len(set(combined.tolist())) == 8
+
+
+def test_set_epoch_reshuffles_deterministically():
+    a = ShardedSampler(100, num_shards=2, shard_id=0, seed=5)
+    b = ShardedSampler(100, num_shards=2, shard_id=0, seed=5)
+    a.set_epoch(0)
+    b.set_epoch(0)
+    e0 = a.shard_indices()
+    assert np.array_equal(e0, b.shard_indices())
+    a.set_epoch(1)
+    assert not np.array_equal(e0, a.shard_indices())
+    a.set_epoch(0)
+    assert np.array_equal(e0, a.shard_indices())
+
+
+def test_hosts_agree_without_communication():
+    """Every shard derives from the same global permutation independently."""
+    n, shards, epoch = 64, 8, 3
+    views = []
+    for i in range(shards):
+        s = ShardedSampler(n, num_shards=shards, shard_id=i, seed=11)
+        s.set_epoch(epoch)
+        views.append(s.global_indices())
+    for v in views[1:]:
+        assert np.array_equal(views[0], v)
+
+
+def test_no_shuffle_is_identity_order():
+    s = ShardedSampler(8, num_shards=2, shard_id=0, shuffle=False)
+    assert s.shard_indices().tolist() == [0, 2, 4, 6]
+
+
+def test_invalid_shard_id():
+    with pytest.raises(ValueError):
+        ShardedSampler(10, num_shards=2, shard_id=2)

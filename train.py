@@ -1,0 +1,154 @@
+#!/usr/bin/env python3
+"""Distributed training CLI — TPU-native counterpart of reference train.py.
+
+Default invocation (``python train.py``) reproduces the reference's default
+config (reference train.py:214-218): SimpleNet MLP, 10 epochs, per-replica
+batch 64, Adam lr=1e-3, 10,000 synthetic samples, train:val 10:1, best/latest
+checkpoints, epoch-granularity resume — running as one compiled XLA program
+per step on whatever devices are present (CPU, one TPU chip, or a multi-host
+TPU slice via the launch/entrypoint.sh topology contract).
+
+Model/dataset/mesh selection beyond the reference is via the framework flags
+(--model, --dataset, --mesh-*, --partition, --dtype); see
+``distributed_pytorch_example_tpu/utils/config.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax.numpy as jnp
+import optax
+
+import distributed_pytorch_example_tpu as dpx
+from distributed_pytorch_example_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def build_dataset(args, num_samples: int, seed: int):
+    from distributed_pytorch_example_tpu import data as dpx_data
+
+    name = args.dataset
+    if name == "synthetic":
+        return dpx_data.SyntheticClassificationDataset(
+            num_samples=num_samples, num_classes=args.num_classes, seed=seed
+        )
+    if name in ("synthetic-image", "cifar10-synthetic"):
+        return dpx_data.SyntheticImageDataset(
+            num_samples=num_samples,
+            image_size=args.image_size,
+            num_classes=args.num_classes,
+            seed=seed,
+        )
+    if name == "synthetic-tokens":
+        vocab = 50257 if args.model.startswith("gpt") else 30522
+        return dpx_data.SyntheticTokenDataset(
+            num_samples=num_samples, seq_len=args.seq_len, vocab_size=vocab, seed=seed
+        )
+    if name == "cifar10":
+        from distributed_pytorch_example_tpu.data.vision import load_cifar10
+
+        return load_cifar10(train=True)
+    raise ValueError(f"Unknown dataset {name!r}")
+
+
+def build_task(args, model):
+    from distributed_pytorch_example_tpu import train as dpx_train
+
+    if args.dataset in ("synthetic", "synthetic-image", "cifar10", "cifar10-synthetic"):
+        return dpx_train.ClassificationTask()
+    if args.model.startswith("bert"):
+        vocab = getattr(model, "vocab_size", 30522)
+        return dpx_train.MLMTask(vocab_size=vocab, mask_token_id=103)
+    return dpx_train.CausalLMTask()
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    dpx.utils.add_reference_args(parser)
+    dpx.utils.add_framework_args(parser)
+    args = parser.parse_args()
+
+    dpx.runtime.setup_logging()
+    config = dpx.runtime.initialize()
+
+    import jax
+
+    mesh = dpx.runtime.make_mesh(
+        dpx.runtime.MeshSpec(
+            data=args.mesh_data,
+            fsdp=args.mesh_fsdp,
+            tensor=args.mesh_tensor,
+            sequence=args.mesh_sequence,
+        )
+    )
+    dp_size = dpx.runtime.mesh.data_parallel_size(mesh)
+    logger.info(
+        "Starting distributed training with %d processes, %d devices, mesh %s",
+        jax.process_count(),
+        len(jax.devices()),
+        dict(mesh.shape),
+    )
+    logger.info(
+        "Configuration: epochs=%d, batch_size=%d (global %d), lr=%s",
+        args.epochs,
+        args.batch_size,
+        args.batch_size * dp_size,
+        args.lr,
+    )
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    overrides = {}
+    if args.model in ("mlp",) or args.model.startswith("resnet"):
+        overrides = {"num_classes": args.num_classes, "dtype": dtype}
+    model = dpx.models.get_model(args.model, **overrides)
+    task = build_task(args, model)
+
+    if args.partition == "fsdp":
+        partitioner = dpx.parallel.fsdp(mesh)
+    elif args.partition == "tp":
+        from distributed_pytorch_example_tpu.parallel.partition import (
+            transformer_partitioner,
+        )
+
+        partitioner = transformer_partitioner(mesh)
+    else:
+        partitioner = dpx.parallel.data_parallel(mesh)
+
+    # Reference semantics: --batch-size is per data-parallel replica
+    # (train.py:215 with one process per device); global batch scales with
+    # the data-parallel size.
+    global_batch = args.batch_size * dp_size
+    train_ds = build_dataset(args, args.num_samples, seed=args.seed)
+    val_ds = build_dataset(args, max(args.num_samples // 10, global_batch), seed=args.seed + 1)
+    train_loader = dpx.data.DeviceLoader(
+        train_ds, global_batch, mesh=mesh, shuffle=True, seed=args.seed
+    )
+    val_loader = dpx.data.DeviceLoader(
+        val_ds, global_batch, mesh=mesh, shuffle=False, seed=args.seed
+    )
+    logger.info(
+        "Dataset size: %d, batches per epoch: %d", len(train_ds), len(train_loader)
+    )
+
+    trainer = dpx.train.Trainer(
+        model,
+        task,
+        optax.adam(args.lr),
+        partitioner=partitioner,
+        checkpoint_dir=args.checkpoint_dir,
+        log_every=args.log_every,
+        seed=args.seed,
+    )
+    trainer.fit(
+        train_loader,
+        val_loader,
+        epochs=args.epochs,
+        resume=args.resume,
+    )
+    dpx.runtime.shutdown()
+
+
+if __name__ == "__main__":
+    main()
