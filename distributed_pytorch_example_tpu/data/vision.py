@@ -1,0 +1,115 @@
+"""Real vision datasets from local files (zero-egress environment).
+
+The reference avoids the download problem entirely with synthetic data
+(reference train.py:53-67); real datasets are the framework's extension for
+the BASELINE.json configs. Loaders here read standard on-disk formats:
+
+- CIFAR-10: the canonical python-pickle batches (``cifar-10-batches-py/``);
+- ImageFolder-style: ``<root>/<class_name>/*.npy`` arrays (pre-decoded
+  NHWC), for ImageNet-scale runs where decode happens offline.
+
+No downloading: if the files are absent the loader raises with guidance to
+use the synthetic datasets instead (``--dataset synthetic-image``). Returned
+datasets expose the same map-style + ``get_batch`` interface as
+``data/synthetic.py``, so the DeviceLoader pipeline is identical.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, Optional
+
+import numpy as np
+
+from distributed_pytorch_example_tpu.data.synthetic import _ArrayDataset
+
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+
+def _data_root(data_dir: Optional[str]) -> str:
+    return data_dir or os.environ.get("DPX_DATA_DIR", "./data")
+
+
+class Cifar10Dataset(_ArrayDataset):
+    """CIFAR-10 as normalized float32 NHWC with int32 labels."""
+
+    num_classes = 10
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray):
+        super().__init__({"x": images, "y": labels})
+
+
+def load_cifar10(
+    train: bool = True,
+    data_dir: Optional[str] = None,
+    normalize: bool = True,
+) -> Cifar10Dataset:
+    """Load CIFAR-10 from the standard python-pickle batch files.
+
+    Expects ``<data_dir>/cifar-10-batches-py/{data_batch_1..5,test_batch}``
+    (the layout of the canonical ``cifar-10-python.tar.gz`` extraction).
+    """
+    root = os.path.join(_data_root(data_dir), "cifar-10-batches-py")
+    names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+    paths = [os.path.join(root, n) for n in names]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        raise FileNotFoundError(
+            f"CIFAR-10 batch files not found (first missing: {missing[0]}). "
+            "This environment has no network egress — place the extracted "
+            "cifar-10-batches-py/ under the data dir, or use "
+            "--dataset synthetic-image for a download-free run."
+        )
+    images, labels = [], []
+    for p in paths:
+        with open(p, "rb") as f:
+            batch = pickle.load(f, encoding="bytes")
+        # rows are 3072 bytes, CHW planar → NHWC
+        arr = batch[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        images.append(arr)
+        labels.append(np.asarray(batch[b"labels"], np.int32))
+    x = np.concatenate(images).astype(np.float32) / 255.0
+    y = np.concatenate(labels)
+    if normalize:
+        x = (x - CIFAR10_MEAN) / CIFAR10_STD
+    return Cifar10Dataset(x, y)
+
+
+def load_image_folder(
+    root: str,
+    image_size: int = 224,
+) -> _ArrayDataset:
+    """ImageFolder-of-.npy loader: ``<root>/<class>/*.npy`` NHWC arrays.
+
+    Classes are sorted directory names → label ids (the torchvision
+    ImageFolder convention). For datasets that fit in host RAM; the
+    ImageNet-scale path is the synthetic-image pipeline until a streaming
+    loader lands.
+    """
+    if not os.path.isdir(root):
+        raise FileNotFoundError(
+            f"ImageFolder root {root!r} does not exist. Use "
+            "--dataset synthetic-image in zero-egress environments."
+        )
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+    )
+    if not classes:
+        raise FileNotFoundError(f"No class directories under {root!r}")
+    xs, ys = [], []
+    for label, cls in enumerate(classes):
+        for fname in sorted(os.listdir(os.path.join(root, cls))):
+            if fname.endswith(".npy"):
+                arr = np.load(os.path.join(root, cls, fname))
+                if arr.shape[:2] != (image_size, image_size):
+                    raise ValueError(
+                        f"{fname}: expected {image_size}x{image_size} NHWC, "
+                        f"got {arr.shape}"
+                    )
+                xs.append(arr.astype(np.float32))
+                ys.append(label)
+    return _ArrayDataset(
+        {"x": np.stack(xs), "y": np.asarray(ys, np.int32)}
+    )

@@ -1,0 +1,75 @@
+"""BERT-base encoder with MLM head for BASELINE.json config 4.
+
+Post-LN transformer encoder (original BERT architecture): token + learned
+position embeddings → embedding LayerNorm/dropout → 12 post-LN blocks → MLM
+head (dense+gelu+LN, decoder tied to the token embedding matrix).
+
+MLM masking itself is on-device inside the train step (``train.tasks.MLMTask``)
+so the host pipeline only ships raw token ids.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_pytorch_example_tpu.models.transformer import TransformerStack
+
+
+class BertBase(nn.Module):
+    vocab_size: int = 30522
+    max_len: int = 512
+    model_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False):
+        # tokens: (B, S) int32 → logits (B, S, vocab)
+        embed = nn.Embed(
+            self.vocab_size,
+            self.model_dim,
+            embedding_init=nn.initializers.normal(stddev=0.02),
+            name="tok_embed",
+        )
+        x = embed(tokens).astype(self.dtype)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, self.max_len, self.model_dim),
+        )
+        x = x + pos[:, : tokens.shape[1]].astype(self.dtype)
+        x = nn.LayerNorm(epsilon=1e-12, dtype=self.dtype, name="embed_ln")(x)
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+
+        x = TransformerStack(
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            head_dim=self.model_dim // self.num_heads,
+            model_dim=self.model_dim,
+            mlp_dim=self.mlp_dim,
+            causal=False,
+            prenorm=False,  # post-LN: original BERT
+            dropout_rate=self.dropout_rate,
+            layer_norm_epsilon=1e-12,
+            dtype=self.dtype,
+            use_flash=self.use_flash,
+            remat=self.remat,
+            name="encoder",
+        )(x, train=train)
+
+        # MLM head: transform, then decode against the tied embedding matrix.
+        x = nn.Dense(self.model_dim, dtype=self.dtype, name="mlm_dense")(x)
+        x = nn.gelu(x)
+        x = nn.LayerNorm(epsilon=1e-12, dtype=self.dtype, name="mlm_ln")(x)
+        logits = x.astype(jnp.float32) @ embed.embedding.T.astype(jnp.float32)
+        bias = self.param("mlm_bias", nn.initializers.zeros_init(), (self.vocab_size,))
+        return logits + bias

@@ -1,0 +1,70 @@
+"""GPT-2 (124M) decoder LM for BASELINE.json config 5 — the flagship model.
+
+Pre-LN causal transformer: token + learned position embeddings → 12 pre-LN
+blocks with causal attention → final LN → logits via the tied token-embedding
+matrix. 124M-parameter config: 12 layers, 768 dim, 12 heads, 1024 context,
+50257 vocab.
+
+Causal masking happens inside the attention kernel (flash computes only the
+lower-triangular blocks; the XLA path masks logits), never as a host-side
+mask tensor.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_pytorch_example_tpu.models.transformer import TransformerStack
+
+
+class GPT2(nn.Module):
+    vocab_size: int = 50257
+    max_len: int = 1024
+    model_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False):
+        # tokens: (B, S) int32 → logits (B, S, vocab)
+        embed = nn.Embed(
+            self.vocab_size,
+            self.model_dim,
+            embedding_init=nn.initializers.normal(stddev=0.02),
+            name="wte",
+        )
+        pos = self.param(
+            "wpe",
+            nn.initializers.normal(stddev=0.01),
+            (1, self.max_len, self.model_dim),
+        )
+        x = embed(tokens).astype(self.dtype) + pos[:, : tokens.shape[1]].astype(self.dtype)
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+
+        x = TransformerStack(
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            head_dim=self.model_dim // self.num_heads,
+            model_dim=self.model_dim,
+            mlp_dim=self.mlp_dim,
+            causal=True,
+            prenorm=True,
+            dropout_rate=self.dropout_rate,
+            layer_norm_epsilon=1e-5,
+            dtype=self.dtype,
+            use_flash=self.use_flash,
+            remat=self.remat,
+            name="decoder",
+        )(x, train=train)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="final_ln")(x)
+        # weight-tied LM head; float32 logits for a stable softmax
+        return x.astype(jnp.float32) @ embed.embedding.T.astype(jnp.float32)

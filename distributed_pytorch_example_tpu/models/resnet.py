@@ -1,0 +1,141 @@
+"""ResNet-18 / ResNet-50 in NHWC for the vision BASELINE.json configs.
+
+The reference has no conv model (reference train.py:32-50 is an MLP); these
+cover BASELINE.json configs 1-2 (ResNet-18/CIFAR-10, ResNet-50/ImageNet).
+
+TPU-first choices:
+- NHWC layout throughout — XLA:TPU's preferred conv layout (channels last is
+  the contiguous lane dimension on the MXU);
+- BatchNorm runs inside the jitted step on the *globally sharded* batch, so
+  batch statistics are computed over the global batch — stronger than the
+  reference-style per-replica DDP stats (free SyncBN: the mean/var reduces
+  become XLA collectives over the data axes);
+- compute dtype configurable (bfloat16 keeps convs on the MXU at full rate);
+  params and batch stats stay float32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs + identity shortcut (ResNet-18/34)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides, name="shortcut_conv")(residual)
+            residual = self.norm(name="shortcut_norm")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 reduce → 3x3 → 1x1 expand (ResNet-50/101/152)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # zero-init the last norm scale: residual branch starts as identity
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides, name="shortcut_conv")(residual)
+            residual = self.norm(name="shortcut_norm")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """NHWC ResNet; ``small_inputs`` switches to the CIFAR 3x3 stem."""
+
+    stage_sizes: Sequence[int]
+    block_cls: Callable
+    num_classes: int = 1000
+    num_filters: int = 64
+    small_inputs: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
+        )
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        if self.small_inputs:  # CIFAR stem: keep 32x32 resolution
+            x = conv(self.num_filters, (3, 3), name="stem_conv")(x)
+        else:  # ImageNet stem: 7x7/2 + 3x3/2 maxpool
+            x = conv(self.num_filters, (7, 7), (2, 2), name="stem_conv")(x)
+        x = norm(name="stem_norm")(x)
+        x = nn.relu(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            for block in range(num_blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2**stage,
+                    conv=conv,
+                    norm=norm,
+                    strides=strides,
+                    name=f"stage{stage}_block{block}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+def ResNet18(num_classes: int = 10, small_inputs: bool = True, **kw) -> ResNet:
+    """BASELINE.json config 1 default: CIFAR-10 (10 classes, 32x32 stem)."""
+    return ResNet(
+        stage_sizes=(2, 2, 2, 2),
+        block_cls=BasicBlock,
+        num_classes=num_classes,
+        small_inputs=small_inputs,
+        **kw,
+    )
+
+
+def ResNet50(num_classes: int = 1000, small_inputs: bool = False, **kw) -> ResNet:
+    """BASELINE.json config 2 default: ImageNet (1000 classes, 224x224 stem)."""
+    return ResNet(
+        stage_sizes=(3, 4, 6, 3),
+        block_cls=BottleneckBlock,
+        num_classes=num_classes,
+        small_inputs=small_inputs,
+        **kw,
+    )

@@ -1,0 +1,176 @@
+"""Shared transformer building blocks for ViT / BERT / GPT-2.
+
+The reference has no transformer (its model is a 3-layer MLP, reference
+train.py:32-50); these blocks exist for the BASELINE.json workload configs.
+They are written TPU-first:
+
+- attention routes through ``ops.attention.dot_product_attention`` so kernel
+  selection (XLA / Pallas flash / ring) is centralized and swappable;
+- projections are named ``q/k/v/o`` and ``up/down`` so the tensor-parallel
+  partition rules in ``parallel/partition.py`` can target them by path regex
+  (Megatron-style column/row split, expressed as GSPMD shardings — XLA
+  propagates activation shardings and inserts the collectives);
+- compute dtype is a field (bfloat16 on TPU keeps the MXU fed); params stay
+  float32 (flax ``param_dtype`` default) for stable optimizer math;
+- optional ``remat`` wraps each block in ``nn.remat`` to trade FLOPs for HBM
+  on long sequences.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_pytorch_example_tpu.ops.attention import dot_product_attention
+
+
+class MultiHeadAttention(nn.Module):
+    """Self-attention with centralized kernel dispatch.
+
+    Layout is (batch, seq, heads, head_dim) end to end — the MXU/sequence-
+    sharding friendly layout (see ops/attention.py).
+    """
+
+    num_heads: int
+    head_dim: int
+    model_dim: int
+    causal: bool = False
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None  # None = auto-select
+
+    @nn.compact
+    def __call__(self, x, mask=None, *, train: bool = False):
+        features = self.num_heads * self.head_dim
+        q = nn.Dense(features, dtype=self.dtype, name="q")(x)
+        k = nn.Dense(features, dtype=self.dtype, name="k")(x)
+        v = nn.Dense(features, dtype=self.dtype, name="v")(x)
+        batch, seq = x.shape[0], x.shape[1]
+        shape = (batch, seq, self.num_heads, self.head_dim)
+        out = dot_product_attention(
+            q.reshape(shape),
+            k.reshape(shape),
+            v.reshape(shape),
+            mask=mask,
+            causal=self.causal,
+            use_flash=self.use_flash,
+        )
+        out = out.reshape((batch, seq, features))
+        out = nn.Dense(self.model_dim, dtype=self.dtype, name="o")(out)
+        if self.dropout_rate:
+            out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
+        return out
+
+
+class MlpBlock(nn.Module):
+    """Position-wise feed-forward: up-project → activation → down-project."""
+
+    mlp_dim: int
+    model_dim: int
+    activation: Callable = nn.gelu
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype, name="up")(x)
+        x = self.activation(x)
+        x = nn.Dense(self.model_dim, dtype=self.dtype, name="down")(x)
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return x
+
+
+class TransformerBlock(nn.Module):
+    """One encoder/decoder block; pre-LN (GPT/ViT) or post-LN (BERT)."""
+
+    num_heads: int
+    head_dim: int
+    model_dim: int
+    mlp_dim: int
+    causal: bool = False
+    prenorm: bool = True
+    dropout_rate: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None, *, train: bool = False):
+        attn = MultiHeadAttention(
+            num_heads=self.num_heads,
+            head_dim=self.head_dim,
+            model_dim=self.model_dim,
+            causal=self.causal,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            use_flash=self.use_flash,
+            name="attn",
+        )
+        mlp = MlpBlock(
+            mlp_dim=self.mlp_dim,
+            model_dim=self.model_dim,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="mlp",
+        )
+        ln1 = nn.LayerNorm(epsilon=self.layer_norm_epsilon, dtype=self.dtype, name="ln1")
+        ln2 = nn.LayerNorm(epsilon=self.layer_norm_epsilon, dtype=self.dtype, name="ln2")
+        if self.prenorm:
+            x = x + attn(ln1(x), mask, train=train)
+            x = x + mlp(ln2(x), train=train)
+        else:  # post-LN (original BERT)
+            x = ln1(x + attn(x, mask, train=train))
+            x = ln2(x + mlp(x, train=train))
+        return x
+
+
+class TransformerStack(nn.Module):
+    """N homogeneous transformer blocks.
+
+    With ``remat=True`` each block is rematerialized (``jax.checkpoint``
+    lifted through flax): activations are recomputed in the backward pass,
+    trading FLOPs for HBM — the standard TPU long-sequence memory lever.
+    The ``train`` flag stays a static closure capture, never a traced arg.
+    """
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    model_dim: int
+    mlp_dim: int
+    causal: bool = False
+    prenorm: bool = True
+    dropout_rate: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask=None, *, train: bool = False):
+        for i in range(self.num_layers):
+            block = TransformerBlock(
+                num_heads=self.num_heads,
+                head_dim=self.head_dim,
+                model_dim=self.model_dim,
+                mlp_dim=self.mlp_dim,
+                causal=self.causal,
+                prenorm=self.prenorm,
+                dropout_rate=self.dropout_rate,
+                layer_norm_epsilon=self.layer_norm_epsilon,
+                dtype=self.dtype,
+                use_flash=self.use_flash,
+                name=f"layer_{i}",
+            )
+            if self.remat:
+                apply = nn.remat(
+                    lambda mdl, h, m: TransformerBlock.__call__(mdl, h, m, train=train),
+                    prevent_cse=False,
+                )
+                x = apply(block, x, mask)
+            else:
+                x = block(x, mask, train=train)
+        return x

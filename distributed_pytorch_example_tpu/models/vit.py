@@ -1,0 +1,78 @@
+"""ViT-B/16 for BASELINE.json config 3 (ImageNet classification).
+
+Vision Transformer: conv patch embedding → [CLS] token + learned position
+embeddings → pre-LN transformer encoder → final LN → linear head. Built on
+``models.transformer`` so attention dispatch, tensor-parallel naming
+(q/k/v/o, up/down), and remat come from the shared blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_pytorch_example_tpu.models.transformer import TransformerStack
+
+
+class VisionTransformer(nn.Module):
+    num_classes: int = 1000
+    patch_size: int = 16
+    model_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        # x: (B, H, W, C) NHWC
+        x = x.astype(self.dtype)
+        p = self.patch_size
+        x = nn.Conv(
+            self.model_dim, (p, p), strides=(p, p), padding="VALID",
+            dtype=self.dtype, name="patch_embed",
+        )(x)
+        batch = x.shape[0]
+        x = x.reshape((batch, -1, self.model_dim))  # (B, num_patches, D)
+
+        cls = self.param(
+            "cls_token", nn.initializers.zeros_init(), (1, 1, self.model_dim)
+        )
+        x = jnp.concatenate([jnp.tile(cls, (batch, 1, 1)).astype(self.dtype), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], self.model_dim),
+        )
+        x = x + pos.astype(self.dtype)
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+
+        x = TransformerStack(
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            head_dim=self.model_dim // self.num_heads,
+            model_dim=self.model_dim,
+            mlp_dim=self.mlp_dim,
+            causal=False,
+            prenorm=True,
+            dropout_rate=self.dropout_rate,
+            layer_norm_epsilon=1e-6,
+            dtype=self.dtype,
+            use_flash=self.use_flash,
+            remat=self.remat,
+            name="encoder",
+        )(x, train=train)
+        x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="final_ln")(x)
+        cls_out = x[:, 0]
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(cls_out)
+
+
+def ViTB16(num_classes: int = 1000, **kw) -> VisionTransformer:
+    """ViT-Base/16: 12 layers, 768 dim, 12 heads, MLP 3072 (~86M params)."""
+    return VisionTransformer(num_classes=num_classes, **kw)

@@ -64,6 +64,10 @@ def dot_product_attention(
 
     if use_flash is None:
         use_flash = _flash_supported(q, k, v, mask)
+    elif use_flash and mask is not None:
+        # flash has no custom-mask path; silently dropping the mask would be
+        # a correctness bug, so fall back to XLA
+        use_flash = False
     if use_flash:
         from distributed_pytorch_example_tpu.ops.pallas import flash_attention
 

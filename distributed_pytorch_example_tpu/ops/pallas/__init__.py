@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference's hot loop runs on cuDNN/ATen CUDA kernels via torch
+(reference train.py:132-141); here the hot ops are hand-tiled for the TPU
+memory hierarchy (HBM → VMEM → MXU) with Pallas:
+
+- ``flash_attention`` — fused online-softmax attention, O(S) HBM traffic,
+  custom VJP with flash backward kernels.
+
+Every kernel has a pure-XLA reference path (ops/attention.py) used on CPU
+and for numerics tests (interpret mode).
+"""

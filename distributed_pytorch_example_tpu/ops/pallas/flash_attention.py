@@ -1,0 +1,345 @@
+"""Flash attention for TPU in Pallas: fused online-softmax, O(S) HBM traffic.
+
+Forward: for each (batch, head, q-block), stream k/v blocks through VMEM,
+maintaining the online-softmax running max ``m``, normalizer ``l``, and
+accumulator in float32 VMEM scratch; one MXU matmul per (q-block, k-block)
+pair for logits and one for the value update. Emits the per-row logsumexp so
+the backward pass can reconstruct softmax weights without re-reducing.
+
+Backward: the standard flash backward split into two kernels — one
+accumulating dq over k-blocks, one accumulating (dk, dv) over q-blocks —
+using the saved logsumexp and the precomputed ``delta = rowsum(dO * O)``
+(delta is a cheap elementwise reduce left to XLA, which fuses it).
+
+Causal masking is block-aware: fully-masked (q-block, k-block) pairs skip
+their compute entirely, halving causal FLOPs.
+
+Layout: (batch, seq, heads, head_dim) at the boundary — transposed to
+(batch, heads, seq, head_dim) internally so the seq x head_dim tiles are
+contiguous MXU operands.
+
+All block sizes default to 128 (MXU-native). ``interpret=True`` runs the
+same kernels on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() NaN-free
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nj = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: skip blocks strictly above the diagonal
+    needed = (j * block_k <= (i + 1) * block_q - 1) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]  # (block_q, head_dim)
+        k = k_ref[0, 0]  # (block_k, head_dim)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (block_q, block_k)
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(row >= col, s, NEG_INF)
+        m_prev = m_ref[:, :1]  # (block_q, 1)
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # (block_q, block_k)
+        l_ref[:] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+        )
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, :1] + jnp.log(safe_l)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    # q, k, v: (B, N, S, H)
+    batch, heads, seq_q, head_dim = q.shape
+    seq_k = k.shape[2]
+    grid = (batch, heads, seq_q // block_q, seq_k // block_k)
+
+    qspec = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i, j: (b, n, i, 0))
+    kspec = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, n, i, j: (b, n, j, 0))
+
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=grid,
+        in_specs=[qspec, kspec, kspec],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i, j: (b, n, i, 0)),
+            # lse rides as (B, N, S, 1): block (…, block_q, 1) satisfies the
+            # TPU tile rule (last dim == array dim, 2nd-to-last % 8 == 0)
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, n, i, j: (b, n, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, seq_q, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem((block_q, head_dim)),  # acc
+            _vmem((block_q, 128)),       # running max m (lane-replicated)
+            _vmem((block_q, 128)),       # running normalizer l
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _vmem(shape, dtype=jnp.float32):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nj = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    needed = (j * block_k <= (i + 1) * block_q - 1) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # (block_q, 1)
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(row >= col, s, NEG_INF)
+        p = jnp.exp(s - lse)  # (block_q, block_k)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    j, i = pl.program_id(2), pl.program_id(3)  # k-block outer, q-block inner
+    ni = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    needed = ((i + 1) * block_q - 1 >= j * block_k) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # (block_q, 1)
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(row >= col, s, NEG_INF)
+        p = jnp.exp(s - lse)  # (block_q, block_k)
+        # dv += p^T @ dO
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        # dk += ds^T @ q
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == ni - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret):
+    batch, heads, seq_q, head_dim = q.shape
+    seq_k = k.shape[2]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )  # (B, N, S, 1), same carry layout as lse
+
+    qspec = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i, j: (b, n, i, 0))
+    kspec = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, n, i, j: (b, n, j, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q, 1), lambda b, n, i, j: (b, n, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(batch, heads, seq_q // block_q, seq_k // block_k),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[_vmem((block_q, head_dim))],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # k-block-major grid: q streams innermost
+    qspec_t = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, j, i: (b, n, i, 0))
+    kspec_t = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, n, j, i: (b, n, j, 0))
+    rowspec_t = pl.BlockSpec((1, 1, block_q, 1), lambda b, n, j, i: (b, n, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(batch, heads, seq_k // block_k, seq_q // block_q),
+        in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t, rowspec_t],
+        out_specs=[kspec_t, kspec_t],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[_vmem((block_k, head_dim)), _vmem((block_k, head_dim))],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API with custom VJP
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+    q, k, v, out, lse = residuals
+    return _bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused flash attention; (B, S, N, H) in and out.
+
+    Sequence lengths must be multiples of the block sizes (the dispatcher in
+    ops/attention.py guarantees this before selecting the flash path).
+    """
+    if softmax_scale is None:
+        softmax_scale = q.shape[-1] ** -0.5
+    seq_q, seq_k = q.shape[1], k.shape[1]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    if seq_q % block_q or seq_k % block_k:
+        raise ValueError(
+            f"seq lengths ({seq_q}, {seq_k}) must divide by blocks "
+            f"({block_q}, {block_k})"
+        )
+    # (B, S, N, H) -> (B, N, S, H)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = _flash(qt, kt, vt, causal, float(softmax_scale), block_q, block_k, interpret)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,58 @@
+"""Transformer partition rules: Megatron-style TP expressed as GSPMD shardings.
+
+The reference's only strategy is DP (reference train.py:233); TP/SP are the
+framework's TPU-first extensions (SURVEY.md §2 parallelism table). Instead of
+rewriting layers with explicit collectives, the rules below shard the weight
+matrices and let XLA's sharding propagation insert the all-reduces:
+
+- column-parallel (shard output features on ``tensor``): attention q/k/v and
+  MLP up-projection — activations come out sharded over heads/hidden;
+- row-parallel (shard input features on ``tensor``): attention output proj
+  and MLP down-projection — XLA emits one all-reduce per block pair, exactly
+  the Megatron schedule, compiled onto ICI;
+- biases of column-parallel layers shard with their features; row-parallel
+  biases and all LayerNorm/embedding/head params stay replicated;
+- everything else (conv stems, norms, embeddings) follows the ``default``
+  policy: replicated for TP, largest-axis-sharded when combined with FSDP.
+
+Because optimizer moments mirror the param tree paths (parallel/api.py), the
+same rules shard Adam's mu/nu automatically.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_pytorch_example_tpu.parallel.api import (
+    Partitioner,
+    Rule,
+    shard_largest_axis,
+)
+
+# Paths follow the naming contract of models/transformer.py:
+#   .../attn/{q,k,v,o}/{kernel,bias}, .../mlp/{up,down}/{kernel,bias}
+TRANSFORMER_TP_RULES: tuple = (
+    # column-parallel: shard output dim
+    (r"attn/(q|k|v)/kernel$", P(None, "tensor")),
+    (r"attn/(q|k|v)/bias$", P("tensor")),
+    (r"mlp/up/kernel$", P(None, "tensor")),
+    (r"mlp/up/bias$", P("tensor")),
+    # row-parallel: shard input dim, replicate bias
+    (r"attn/o/kernel$", P("tensor", None)),
+    (r"mlp/down/kernel$", P("tensor", None)),
+)
+
+
+def transformer_partitioner(
+    mesh: Mesh,
+    fsdp_rest: bool = False,
+) -> Partitioner:
+    """TP rules for transformer blocks; remaining params replicated or FSDP.
+
+    ``fsdp_rest=True`` composes TP with ZeRO-style sharding: any leaf not
+    matched by a TP rule (embeddings, norms, conv stems) is sharded along its
+    largest dim on the ``fsdp`` axis.
+    """
+    rules: list[Rule] = list(TRANSFORMER_TP_RULES)
+    default = shard_largest_axis("fsdp", mesh) if fsdp_rest else P()
+    return Partitioner(mesh, rules=rules, default=default)

@@ -163,13 +163,12 @@ class Trainer:
                         record["val_accuracy"],
                     )
 
+            is_best = (
+                val_loader is not None and record["val_accuracy"] > best_accuracy
+            )
+            if is_best:
+                best_accuracy = record["val_accuracy"]
             if self.checkpoint_dir:
-                is_best = (
-                    val_loader is not None
-                    and record["val_accuracy"] > best_accuracy
-                )
-                if is_best:
-                    best_accuracy = record["val_accuracy"]
                 extra = {"best_accuracy": best_accuracy}
                 # epoch+1 so resume continues AFTER the finished epoch
                 if is_best:

@@ -58,7 +58,9 @@ class ClassificationTask:
 class CausalLMTask:
     """Next-token LM on dict batches {'tokens'} (GPT-2 config).
 
-    The model sees tokens[:, :-1] and predicts tokens[:, 1:].
+    The model sees the FULL sequence (keeping seq_len block-aligned so the
+    flash kernel stays eligible); position t's logits predict token t+1, and
+    the final position's logits are simply excluded from the loss.
     """
 
     batch_keys = ("tokens",)
@@ -67,8 +69,8 @@ class CausalLMTask:
         self, model, params, model_state, batch, rng, *, train: bool
     ) -> Tuple[jax.Array, Metrics, Any]:
         tokens = batch["tokens"]
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits, new_ms = _apply_model(model, params, model_state, inputs, rng, train)
+        logits, new_ms = _apply_model(model, params, model_state, tokens, rng, train)
+        logits, targets = logits[:, :-1], tokens[:, 1:]
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), targets
         ).mean()

@@ -47,4 +47,12 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         help="dp|fsdp|tp (tp uses per-model transformer rules)")
     parser.add_argument("--dtype", type=str, default="float32",
                         help="compute dtype: float32|bfloat16")
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialize transformer blocks (memory for FLOPs)")
+    parser.add_argument("--flash", type=str, default="auto",
+                        choices=("auto", "on", "off"),
+                        help="Pallas flash attention: auto-select, force, or disable")
+    parser.add_argument("--data-dir", type=str, default=None,
+                        help="root for real datasets (cifar10); defaults to "
+                        "$DPX_DATA_DIR or ./data")
     return parser

@@ -49,7 +49,7 @@ def build_dataset(args, num_samples: int, seed: int):
     if name == "cifar10":
         from distributed_pytorch_example_tpu.data.vision import load_cifar10
 
-        return load_cifar10(train=True)
+        return load_cifar10(train=True, data_dir=args.data_dir)
     raise ValueError(f"Unknown dataset {name!r}")
 
 
@@ -99,9 +99,14 @@ def main():
     )
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    overrides = {}
-    if args.model in ("mlp",) or args.model.startswith("resnet"):
-        overrides = {"num_classes": args.num_classes, "dtype": dtype}
+    overrides = {"dtype": dtype}
+    if args.model in ("mlp",) or args.model.startswith("resnet") or args.model.startswith("vit"):
+        overrides["num_classes"] = args.num_classes
+    if args.model.startswith(("vit", "bert", "gpt")):
+        if args.remat:
+            overrides["remat"] = True
+        if args.flash != "auto":
+            overrides["use_flash"] = args.flash == "on"
     model = dpx.models.get_model(args.model, **overrides)
     task = build_task(args, model)
 
