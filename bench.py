@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Headline benchmark: ResNet-50 synthetic-ImageNet samples/sec/chip.
+
+Matches the driver metric in BASELINE.json ("samples/sec/chip ...
+ResNet-50/ImageNet"). The baseline anchor is the north-star threshold: 60%
+of published torch-xla ResNet-50 throughput (~1000 samples/sec/chip on
+v4 in bf16), i.e. 600 samples/sec/chip → ``vs_baseline = value / 600``.
+
+Prints exactly ONE JSON line on stdout; all logging goes to stderr.
+
+Usage: python bench.py [--model resnet50] [--batch-per-chip N] [--steps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_SAMPLES_PER_SEC_PER_CHIP = 600.0  # 60% of published torch-xla v4
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet50")
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--batch-per-chip", type=int, default=128)
+    parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--steps", type=int, default=20)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import distributed_pytorch_example_tpu as dpx
+
+    n_chips = len(jax.devices())
+    print(
+        f"bench: {args.model} on {n_chips} {jax.devices()[0].platform} "
+        f"device(s), batch/chip={args.batch_per_chip}",
+        file=sys.stderr,
+    )
+
+    mesh = dpx.runtime.make_mesh()
+    partitioner = dpx.parallel.data_parallel(mesh)
+    model = dpx.models.get_model(
+        args.model, num_classes=1000, dtype=jnp.bfloat16
+    )
+    task = dpx.train.ClassificationTask()
+    trainer = dpx.train.Trainer(
+        model, task, optax.adam(1e-3), partitioner=partitioner
+    )
+
+    global_batch = args.batch_per_chip * n_chips
+    rng = np.random.default_rng(0)
+    batch_np = {
+        "x": rng.standard_normal(
+            (global_batch, args.image_size, args.image_size, 3)
+        ).astype(np.float32),
+        "y": rng.integers(0, 1000, (global_batch,)).astype(np.int32),
+    }
+    sharding = partitioner.batch_sharding()
+    batch = {
+        k: jax.make_array_from_process_local_data(sharding, v)
+        for k, v in batch_np.items()
+    }
+
+    with mesh:
+        trainer.init(batch["x"])
+        state = trainer.state
+        for _ in range(args.warmup):
+            state, metrics = trainer.train_step(state, batch)
+        # NB: fetch a VALUE, not block_until_ready — under the tunneled
+        # remote-TPU platform only a real device->host transfer reliably
+        # fences the dispatched step chain
+        float(metrics["loss"])
+
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, metrics = trainer.train_step(state, batch)
+        float(metrics["loss"])
+        elapsed = time.perf_counter() - t0
+
+    samples_per_sec = global_batch * args.steps / elapsed
+    per_chip = samples_per_sec / n_chips
+    print(
+        f"bench: {elapsed:.2f}s for {args.steps} steps "
+        f"({samples_per_sec:.1f} samples/s total)",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"{args.model}_samples_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

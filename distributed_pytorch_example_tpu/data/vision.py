@@ -110,6 +110,12 @@ def load_image_folder(
                     )
                 xs.append(arr.astype(np.float32))
                 ys.append(label)
+    if not xs:
+        raise FileNotFoundError(
+            f"No .npy arrays under {root!r} class dirs (this loader reads "
+            "pre-decoded NHWC .npy, not raw images). Use --dataset "
+            "synthetic-image, or pre-decode offline."
+        )
     return _ArrayDataset(
         {"x": np.stack(xs), "y": np.asarray(ys, np.int32)}
     )

@@ -63,11 +63,16 @@ def dot_product_attention(
         softmax_scale = 1.0 / math.sqrt(q.shape[-1])
 
     if use_flash is None:
-        use_flash = _flash_supported(q, k, v, mask)
-    elif use_flash and mask is not None:
-        # flash has no custom-mask path; silently dropping the mask would be
-        # a correctness bug, so fall back to XLA
-        use_flash = False
+        use_flash = _flash_unsupported_reason(q, k, v, mask, causal) is None
+    elif use_flash:
+        # forced flash must not silently degrade or crash deep in lowering:
+        # surface exactly why the kernel can't serve this call
+        reason = _flash_unsupported_reason(q, k, v, mask, causal)
+        if reason is not None:
+            raise ValueError(
+                f"use_flash=True but the flash kernel does not support this "
+                f"call: {reason}. Use use_flash=None to auto-select."
+            )
     if use_flash:
         from distributed_pytorch_example_tpu.ops.pallas import flash_attention
 
@@ -85,14 +90,21 @@ def _on_tpu() -> bool:
         return False
 
 
-def _flash_supported(q, k, v, mask) -> bool:
-    """Flash path: TPU only, no custom mask, block-friendly seq lens."""
-    if mask is not None or not _on_tpu():
-        return False
+def _flash_unsupported_reason(q, k, v, mask, causal) -> Optional[str]:
+    """None if the flash kernel can serve this call, else a human reason."""
+    if mask is not None:
+        return "custom masks are not implemented in the flash kernel"
+    if not _on_tpu():
+        return "flash kernel is TPU-only"
     seq_q, seq_k, head_dim = q.shape[1], k.shape[1], q.shape[-1]
-    return (
-        seq_q % 128 == 0
-        and seq_k % 128 == 0
-        and head_dim in (64, 128, 256)
-        and q.dtype in (jnp.float32, jnp.bfloat16)
-    )
+    if causal and seq_q != seq_k:
+        # flash causal masking is top-left (row >= col) aligned; the XLA
+        # reference is bottom-right aligned — they only agree for seq_q==seq_k
+        return f"causal with seq_q != seq_k ({seq_q} != {seq_k})"
+    if seq_q % 128 or seq_k % 128:
+        return f"seq lengths ({seq_q}, {seq_k}) not multiples of 128"
+    if head_dim not in (64, 128, 256):
+        return f"head_dim {head_dim} not in (64, 128, 256)"
+    if q.dtype not in (jnp.float32, jnp.bfloat16):
+        return f"dtype {q.dtype} not in (float32, bfloat16)"
+    return None

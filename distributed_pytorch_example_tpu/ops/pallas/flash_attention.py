@@ -34,6 +34,18 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() NaN-free
 
 
+def _apply_causal_mask(s, i, j, block_q, block_k):
+    """Top-left-aligned causal mask on a (block_q, block_k) logit tile.
+
+    Valid for seq_q == seq_k (the dispatcher rejects causal cross-length
+    calls); shared by the forward and both backward kernels so the
+    alignment can never diverge between them.
+    """
+    row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(row >= col, s, NEG_INF)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -64,13 +76,7 @@ def _fwd_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (block_q, block_k)
         if causal:
-            row = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            col = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(row >= col, s, NEG_INF)
+            s = _apply_causal_mask(s, i, j, block_q, block_k)
         m_prev = m_ref[:, :1]  # (block_q, 1)
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -165,13 +171,7 @@ def _dq_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            row = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            col = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(row >= col, s, NEG_INF)
+            s = _apply_causal_mask(s, i, j, block_q, block_k)
         p = jnp.exp(s - lse)  # (block_q, block_k)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -214,13 +214,7 @@ def _dkv_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            row = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            col = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(row >= col, s, NEG_INF)
+            s = _apply_causal_mask(s, i, j, block_q, block_k)
         p = jnp.exp(s - lse)  # (block_q, block_k)
         # dv += p^T @ dO
         dv_acc[:] += jax.lax.dot_general(

@@ -65,3 +65,23 @@ def test_small_seq_shrinks_blocks():
     expected = _xla_attention(q, k, v, None, True, scale)
     got = flash_attention(q, k, v, causal=True, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_forced_flash_unsupported_raises():
+    """use_flash=True must fail loudly, not silently degrade (CPU here)."""
+    from distributed_pytorch_example_tpu.ops.attention import dot_product_attention
+
+    q, k, v = make_qkv(seq=128)
+    with pytest.raises(ValueError, match="flash"):
+        dot_product_attention(q, k, v, use_flash=True)  # CPU → unsupported
+
+
+def test_causal_cross_length_not_auto_selected():
+    """Causal seq_q != seq_k disagrees between kernels; auto must pick XLA."""
+    from distributed_pytorch_example_tpu.ops.attention import (
+        _flash_unsupported_reason,
+    )
+
+    q, _, _ = make_qkv(seq=128)
+    k, v, _ = make_qkv(seq=256)
+    assert _flash_unsupported_reason(q, k, v, None, True) is not None

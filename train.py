@@ -26,7 +26,7 @@ from distributed_pytorch_example_tpu.runtime.logging import get_logger
 logger = get_logger(__name__)
 
 
-def build_dataset(args, num_samples: int, seed: int):
+def build_dataset(args, num_samples: int, seed: int, train: bool = True):
     from distributed_pytorch_example_tpu import data as dpx_data
 
     name = args.dataset
@@ -49,7 +49,7 @@ def build_dataset(args, num_samples: int, seed: int):
     if name == "cifar10":
         from distributed_pytorch_example_tpu.data.vision import load_cifar10
 
-        return load_cifar10(train=True, data_dir=args.data_dir)
+        return load_cifar10(train=train, data_dir=args.data_dir)
     raise ValueError(f"Unknown dataset {name!r}")
 
 
@@ -125,8 +125,11 @@ def main():
     # (train.py:215 with one process per device); global batch scales with
     # the data-parallel size.
     global_batch = args.batch_size * dp_size
-    train_ds = build_dataset(args, args.num_samples, seed=args.seed)
-    val_ds = build_dataset(args, max(args.num_samples // 10, global_batch), seed=args.seed + 1)
+    train_ds = build_dataset(args, args.num_samples, seed=args.seed, train=True)
+    val_ds = build_dataset(
+        args, max(args.num_samples // 10, global_batch), seed=args.seed + 1,
+        train=False,
+    )
     train_loader = dpx.data.DeviceLoader(
         train_ds, global_batch, mesh=mesh, shuffle=True, seed=args.seed
     )
