@@ -28,6 +28,7 @@ class BertBase(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None
+    seq_axis: Optional[str] = None  # mesh axis for ring attention (SP)
     remat: bool = False
 
     @nn.compact
@@ -62,6 +63,7 @@ class BertBase(nn.Module):
             layer_norm_epsilon=1e-12,
             dtype=self.dtype,
             use_flash=self.use_flash,
+            seq_axis=self.seq_axis,
             remat=self.remat,
             name="encoder",
         )(x, train=train)

@@ -31,6 +31,10 @@ class MultiHeadAttention(nn.Module):
 
     Layout is (batch, seq, heads, head_dim) end to end — the MXU/sequence-
     sharding friendly layout (see ops/attention.py).
+
+    ``seq_axis``: name of a mesh axis to run ring attention over (sequence/
+    context parallelism). The active mesh comes from the enclosing
+    ``with mesh:`` context; no device ever holds full-sequence K/V.
     """
 
     num_heads: int
@@ -40,6 +44,7 @@ class MultiHeadAttention(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None  # None = auto-select
+    seq_axis: Optional[str] = None  # mesh axis for ring attention
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = False):
@@ -49,19 +54,56 @@ class MultiHeadAttention(nn.Module):
         v = nn.Dense(features, dtype=self.dtype, name="v")(x)
         batch, seq = x.shape[0], x.shape[1]
         shape = (batch, seq, self.num_heads, self.head_dim)
-        out = dot_product_attention(
-            q.reshape(shape),
-            k.reshape(shape),
-            v.reshape(shape),
-            mask=mask,
-            causal=self.causal,
-            use_flash=self.use_flash,
-        )
+        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        ring_mesh = self._ring_mesh(mask)
+        if ring_mesh is not None:
+            from distributed_pytorch_example_tpu.ops.ring_attention import (
+                ring_attention_sharded,
+            )
+
+            out = ring_attention_sharded(
+                q, k, v, ring_mesh, seq_axis=self.seq_axis, causal=self.causal
+            )
+        else:
+            out = dot_product_attention(
+                q, k, v, mask=mask, causal=self.causal, use_flash=self.use_flash
+            )
         out = out.reshape((batch, seq, features))
         out = nn.Dense(self.model_dim, dtype=self.dtype, name="o")(out)
         if self.dropout_rate:
             out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
         return out
+
+    def _ring_mesh(self, mask):
+        """The active mesh when ring attention should run, else None.
+
+        ``seq_axis`` set but no active mesh is a configuration error, not a
+        fallback: silently taking the dense path would materialize the full
+        S x S logits the user sharded the sequence to avoid.
+        """
+        if self.seq_axis is None:
+            return None
+        if self.use_flash:
+            raise ValueError(
+                "seq_axis and use_flash=True conflict: the ring path has no "
+                "flash kernel yet. Set use_flash=None (auto) or False."
+            )
+        if mask is not None:
+            raise NotImplementedError(
+                "custom masks are not supported on the ring-attention path"
+            )
+        from distributed_pytorch_example_tpu.runtime.mesh import current_mesh
+
+        mesh = current_mesh()
+        if mesh is None:
+            raise RuntimeError(
+                f"seq_axis={self.seq_axis!r} requires an active `with mesh:` "
+                "context (Trainer enters it automatically; wrap manual "
+                "apply() calls yourself)."
+            )
+        if mesh.shape.get(self.seq_axis, 1) <= 1:
+            return None  # mesh has no sequence span: dense path is exact
+        return mesh
 
 
 class MlpBlock(nn.Module):
@@ -96,6 +138,7 @@ class TransformerBlock(nn.Module):
     layer_norm_epsilon: float = 1e-5
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = False):
@@ -107,6 +150,7 @@ class TransformerBlock(nn.Module):
             dropout_rate=self.dropout_rate,
             dtype=self.dtype,
             use_flash=self.use_flash,
+            seq_axis=self.seq_axis,
             name="attn",
         )
         mlp = MlpBlock(
@@ -147,6 +191,7 @@ class TransformerStack(nn.Module):
     layer_norm_epsilon: float = 1e-5
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None
+    seq_axis: Optional[str] = None
     remat: bool = False
 
     @nn.compact
@@ -163,6 +208,7 @@ class TransformerStack(nn.Module):
                 layer_norm_epsilon=self.layer_norm_epsilon,
                 dtype=self.dtype,
                 use_flash=self.use_flash,
+                seq_axis=self.seq_axis,
                 name=f"layer_{i}",
             )
             if self.remat:

@@ -26,6 +26,7 @@ class VisionTransformer(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None
+    seq_axis: Optional[str] = None  # mesh axis for ring attention (SP)
     remat: bool = False
 
     @nn.compact
@@ -65,6 +66,7 @@ class VisionTransformer(nn.Module):
             layer_norm_epsilon=1e-6,
             dtype=self.dtype,
             use_flash=self.use_flash,
+            seq_axis=self.seq_axis,
             remat=self.remat,
             name="encoder",
         )(x, train=train)

@@ -1,0 +1,140 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+Long-context support the reference lacks entirely (SURVEY.md §5
+"Long-context / sequence parallelism: ABSENT") but a TPU framework needs as
+a first-class capability: when the sequence is sharded across devices on a
+``sequence`` mesh axis, no device ever materializes full-sequence K/V.
+Instead K/V chunks rotate around the ring via ``lax.ppermute`` (compiled to
+ICI neighbor transfers) while each device folds every chunk into its local
+queries' online softmax — the same math as the flash kernel's k-block loop,
+lifted to the inter-chip level. Compute for the current chunk overlaps with
+the transfer of the next (XLA's latency-hiding scheduler handles it since
+the ppermute has no data dependence on the chunk attention).
+
+Memory per device: O(S_local * S_local) logits per step instead of O(S^2)
+— sequence length scales linearly with ring size.
+
+``ring_attention`` is the per-device collective program (call under
+``shard_map``); ``ring_attention_sharded`` wraps it for callers holding
+global arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention with K/V ring rotation; call inside ``shard_map``.
+
+    Args:
+      q, k, v: local shards (batch, seq_local, heads, head_dim), sharded on
+        the sequence dimension over ``axis_name``.
+      causal: global causal masking — positions are reconstructed from the
+        ring index, so the mask is exact across shard boundaries.
+
+    Returns the local output shard (batch, seq_local, heads, head_dim).
+    """
+    if softmax_scale is None:
+        softmax_scale = q.shape[-1] ** -0.5
+    n_chunks = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    batch, s_loc, heads, head_dim = q.shape
+    qf = q.astype(jnp.float32)
+
+    m0 = jnp.full((batch, s_loc, heads, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((batch, s_loc, heads, 1), jnp.float32)
+    acc0 = jnp.zeros((batch, s_loc, heads, head_dim), jnp.float32)
+    # mark the constant carries as device-varying so the scan carry type
+    # matches the (varying) per-step outputs under shard_map's vma tracking
+    _vary = getattr(lax, "pcast", None)
+    if _vary is not None:
+        mark = lambda x: _vary(x, tuple(jax.typeof(q).vma), to="varying")  # noqa: E731
+    else:  # older jax
+        mark = lambda x: lax.pvary(x, tuple(jax.typeof(q).vma))  # noqa: E731
+    m0, l0, acc0 = jax.tree_util.tree_map(mark, (m0, l0, acc0))
+    shift = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
+
+    def body(carry, step):
+        k_cur, v_cur, m, l, acc = carry
+        src = (idx - step) % n_chunks  # ring owner of the chunk we now hold
+        logits = jnp.einsum(
+            "bqnh,bknh->bqnk", qf, k_cur.astype(jnp.float32)
+        ) * softmax_scale
+        if causal:
+            q_pos = idx * s_loc + lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 0
+            )
+            k_pos = src * s_loc + lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 1
+            )
+            mask = (q_pos >= k_pos)[None, :, None, :]
+            logits = jnp.where(mask, logits, NEG_INF)
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bqnk,bknh->bqnh", p, v_cur.astype(jnp.float32)
+        )
+        # rotate K/V to the next ring neighbor; independent of this step's
+        # attention math, so XLA overlaps the transfer with the compute
+        k_nxt = lax.ppermute(k_cur, axis_name, shift)
+        v_nxt = lax.ppermute(v_cur, axis_name, shift)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    (_, _, m, l, acc), _ = lax.scan(
+        body, (k, v, m0, l0, acc0), jnp.arange(n_chunks)
+    )
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / safe_l).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "sequence",
+    batch_axes: Sequence[str] = ("data", "fsdp"),
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring attention on global (B, S, N, H) arrays: shard, ring, unshard.
+
+    The batch dim shards over ``batch_axes``, the sequence dim over
+    ``seq_axis``; jit composes this with the surrounding program's shardings
+    so no resharding happens when activations already live in this layout.
+    """
+    batch_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    spec = P(batch_axes, seq_axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            ring_attention,
+            axis_name=seq_axis,
+            causal=causal,
+            softmax_scale=softmax_scale,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -95,6 +95,25 @@ def make_mesh(
     return Mesh(dev_array, spec.axis_names)
 
 
+def current_mesh():
+    """The mesh of the enclosing ``with mesh:`` context, or None.
+
+    Lets modules deep inside a model (e.g. ring attention) find the active
+    mesh without threading it through every constructor.
+    """
+    # private import: narrow except so a JAX relayout fails loudly here
+    # instead of silently disabling every mesh-aware op
+    try:
+        from jax._src.mesh import thread_resources
+    except (ImportError, AttributeError) as e:
+        raise RuntimeError(
+            "jax moved jax._src.mesh.thread_resources; update "
+            "runtime.mesh.current_mesh for this jax version"
+        ) from e
+    mesh = thread_resources.env.physical_mesh
+    return mesh if mesh.devices.size > 0 else None
+
+
 def data_axes(mesh) -> Sequence[str]:
     """The mesh axes a global batch is sharded over (data + fsdp)."""
     return tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)

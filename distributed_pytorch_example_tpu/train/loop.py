@@ -17,6 +17,7 @@ Behavioral parity with the reference's ``main()`` orchestration
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from typing import Any, Dict, List, Optional
@@ -64,16 +65,24 @@ class Trainer:
         self.state: Optional[TrainState] = None
         self.state_shardings = None
 
+    def _mesh_ctx(self):
+        """Enter the partitioner's mesh so mesh-aware ops (ring attention)
+        can find it via ``runtime.mesh.current_mesh`` at trace time."""
+        if self.partitioner is not None:
+            return self.partitioner.mesh
+        return contextlib.nullcontext()
+
     # -- state ------------------------------------------------------------
 
     def init(self, sample_inputs: Any) -> TrainState:
-        self.state, self.state_shardings = init_state(
-            self.model,
-            self.optimizer,
-            sample_inputs,
-            jax.random.key(self.seed),
-            self.partitioner,
-        )
+        with self._mesh_ctx():
+            self.state, self.state_shardings = init_state(
+                self.model,
+                self.optimizer,
+                sample_inputs,
+                jax.random.key(self.seed),
+                self.partitioner,
+            )
         n_params = sum(
             int(x.size) for x in jax.tree_util.tree_leaves(self.state.params)
         )
@@ -92,7 +101,8 @@ class Trainer:
         acc = MetricAccumulator()
         num_batches = len(loader)
         for batch_idx, batch in enumerate(loader):
-            self.state, metrics = self.train_step(self.state, batch)
+            with self._mesh_ctx():
+                self.state, metrics = self.train_step(self.state, batch)
             acc.append(metrics)
             if batch_idx % self.log_every == 0 and dist.is_coordinator():
                 logger.info(
@@ -107,7 +117,8 @@ class Trainer:
     def validate(self, loader) -> Dict[str, float]:
         acc = MetricAccumulator()
         for batch in loader:
-            acc.append(self.eval_step(self.state, batch))
+            with self._mesh_ctx():
+                acc.append(self.eval_step(self.state, batch))
         return acc.result()
 
     # -- full fit ---------------------------------------------------------

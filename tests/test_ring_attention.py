@@ -1,0 +1,147 @@
+"""Ring attention vs full attention on the fake 8-device mesh.
+
+The sequence axis spans 4 devices; results must match the single-device
+XLA reference bit-closely for both causal and non-causal, proving the
+cross-shard online-softmax merge and the global causal mask reconstruction.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_example_tpu.ops.attention import _xla_attention
+from distributed_pytorch_example_tpu.ops.ring_attention import ring_attention_sharded
+from distributed_pytorch_example_tpu.runtime import MeshSpec, make_mesh
+
+
+def make_qkv(batch=2, seq=256, heads=2, head_dim=32, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (batch, seq, heads, head_dim)
+    return tuple(
+        jnp.asarray(rng.standard_normal(shape), jnp.float32) for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_full_attention(devices, causal):
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    q, k, v = make_qkv()
+    scale = q.shape[-1] ** -0.5
+    expected = _xla_attention(q, k, v, None, causal, scale)
+    got = ring_attention_sharded(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_full_attention(devices, causal):
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    q, k, v = make_qkv(seq=128)
+    scale = q.shape[-1] ** -0.5
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, None, causal, scale) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh, causal=causal) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for gr, gg, name in zip(g_ref, g_ring, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gr), atol=1e-4, err_msg=f"d{name}"
+        )
+
+
+def test_full_sequence_axis(devices):
+    """All 8 devices on the sequence axis (deepest ring)."""
+    mesh = make_mesh(MeshSpec(data=1, sequence=8))
+    q, k, v = make_qkv(seq=512)
+    scale = q.shape[-1] ** -0.5
+    expected = _xla_attention(q, k, v, None, True, scale)
+    got = ring_attention_sharded(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_inside_jit(devices):
+    """Ring attention composes under jit with mesh-sharded inputs."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    q, k, v = make_qkv()
+    sharding = NamedSharding(mesh, P("data", "sequence", None, None))
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+
+    @jax.jit
+    def f(q, k, v):
+        return ring_attention_sharded(q, k, v, mesh, causal=True)
+
+    got = f(q, k, v)
+    expected = _xla_attention(q, k, v, None, True, q.shape[-1] ** -0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_gpt2_seq_parallel_matches_dense(devices):
+    """Full model with seq_axis under a sequence mesh == no-SP output."""
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+
+    kw = dict(vocab_size=101, max_len=64, model_dim=32, num_layers=2,
+              num_heads=4, mlp_dim=64)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 101, (2, 64)), jnp.int32
+    )
+    dense = GPT2(**kw)
+    sp = GPT2(seq_axis="sequence", **kw)
+    variables = dense.init(jax.random.key(0), tokens, train=False)
+    expected = dense.apply(variables, tokens, train=False)
+
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    with mesh:
+        got = sp.apply(variables, tokens, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_dryrun_multichip_exercises_sp():
+    """The driver dry-run (dp+fsdp+tp+sp mesh) runs a full train step."""
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
+
+
+def test_trainer_actually_uses_ring(devices, monkeypatch, tmp_path):
+    """Trainer enters the mesh context, so seq_axis reaches the ring path.
+
+    The dense fallback is numerically identical, so this guards the wiring
+    (not the math) with a call spy.
+    """
+    import optax
+
+    from distributed_pytorch_example_tpu import ops
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+    from distributed_pytorch_example_tpu.data.synthetic import SyntheticTokenDataset
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+    from distributed_pytorch_example_tpu.parallel.api import data_parallel
+    from distributed_pytorch_example_tpu.train.loop import Trainer
+    from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
+    from distributed_pytorch_example_tpu.ops import ring_attention as ring_mod
+
+    calls = []
+    real = ring_mod.ring_attention_sharded
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ring_mod, "ring_attention_sharded", spy)
+
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    model = GPT2(vocab_size=64, max_len=32, model_dim=32, num_layers=1,
+                 num_heads=4, mlp_dim=64, seq_axis="sequence")
+    ds = SyntheticTokenDataset(num_samples=16, seq_len=16, vocab_size=64)
+    loader = DeviceLoader(ds, 4, mesh=mesh, num_shards=1, shard_id=0)
+    trainer = Trainer(model, CausalLMTask(), optax.adam(1e-3),
+                      partitioner=data_parallel(mesh))
+    trainer.init(next(iter(loader))["tokens"])
+    batch = next(iter(loader))
+    trainer.train_step(trainer.state, batch)
+    assert calls, "ring_attention_sharded was never invoked via the Trainer"

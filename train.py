@@ -107,6 +107,8 @@ def main():
             overrides["remat"] = True
         if args.flash != "auto":
             overrides["use_flash"] = args.flash == "on"
+        if args.mesh_sequence not in (0, 1):
+            overrides["seq_axis"] = "sequence"  # ring attention over the mesh
     model = dpx.models.get_model(args.model, **overrides)
     task = build_task(args, model)
 
