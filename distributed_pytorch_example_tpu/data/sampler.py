@@ -58,23 +58,13 @@ def _permutation_numpy(n: int, seed: int) -> np.ndarray:
     return perm
 
 
-_native_permutation = None
-_native_checked = False
-
-
 def permutation(n: int, seed: int) -> np.ndarray:
     """Deterministic permutation of [0, n), identical across backends."""
-    global _native_permutation, _native_checked
-    if not _native_checked:
-        _native_checked = True
-        try:
-            from distributed_pytorch_example_tpu.native import binding
+    from distributed_pytorch_example_tpu.native import get_binding
 
-            _native_permutation = binding.permutation
-        except Exception:
-            _native_permutation = None
-    if _native_permutation is not None:
-        return _native_permutation(n, seed)
+    binding = get_binding()
+    if binding is not None:
+        return binding.permutation(n, seed)
     return _permutation_numpy(n, seed)
 
 

@@ -17,6 +17,24 @@ from typing import Dict, Sequence
 
 import numpy as np
 
+# below this row size the thread spawn costs more than the parallel memcpy saves
+_NATIVE_GATHER_MIN_ROW_BYTES = 4096
+
+
+def _gather(array: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Row gather; native threaded memcpy for wide rows, else fancy-index."""
+    from distributed_pytorch_example_tpu.native import get_binding
+
+    binding = get_binding()
+    row_bytes = array.dtype.itemsize * int(np.prod(array.shape[1:], dtype=np.int64))
+    if (
+        binding is not None
+        and array.flags.c_contiguous
+        and row_bytes >= _NATIVE_GATHER_MIN_ROW_BYTES
+    ):
+        return binding.gather_rows(array, idx)
+    return array[idx]
+
 
 class _ArrayDataset:
     """Map-style dataset backed by parallel NumPy arrays."""
@@ -36,7 +54,7 @@ class _ArrayDataset:
 
     def get_batch(self, indices: Sequence[int]) -> Dict[str, np.ndarray]:
         idx = np.asarray(indices)
-        return {k: v[idx] for k, v in self.arrays.items()}
+        return {k: _gather(v, idx) for k, v in self.arrays.items()}
 
 
 class SyntheticClassificationDataset(_ArrayDataset):

@@ -1,0 +1,57 @@
+#!/bin/bash
+# Per-host launcher for multi-host TPU training.
+#
+# TPU-native counterpart of the reference launcher (/root/reference/
+# entrypoint.sh:1-39): same topology-from-hostname contract, but instead of
+# torchrun forking NPROC_PER_NODE worker processes, ONE Python process per
+# host joins the job via jax.distributed.initialize (all local TPU chips
+# belong to that process — the idiomatic JAX/TPU process model, SURVEY.md §2
+# native-dependency table, torchrun row).
+#
+# Env contract (reference entrypoint.sh:5-8 parity):
+#   NF_DISCOVERY_SERVICE  headless-service DNS suffix        [required >1 host]
+#   REPLICAS              number of hosts                    [required]
+#   COORDINATOR_PORT      rendezvous port                    [default 29500]
+#   TRAINING_SCRIPT       script to run                      [default train.py]
+#   SCRIPT_ARGS           extra args forwarded to the script [default ""]
+#
+# Derived (reference entrypoint.sh:24-28 parity):
+#   PROCESS_ID          <- numeric suffix of $HOSTNAME   (NODE_RANK=${HOSTNAME##*-})
+#   COORDINATOR_ADDRESS <- ${BASE_NAME}-0.${NF_DISCOVERY_SERVICE}:${COORDINATOR_PORT}
+#
+# The Python side (runtime/distributed.py resolve_config) re-derives both
+# when unset, so this script only needs to validate and exec.
+
+set -euo pipefail
+
+REPLICAS="${REPLICAS:-1}"
+COORDINATOR_PORT="${COORDINATOR_PORT:-${MASTER_PORT:-29500}}"
+TRAINING_SCRIPT="${TRAINING_SCRIPT:-train.py}"
+SCRIPT_ARGS="${SCRIPT_ARGS:-}"
+
+if [ "${REPLICAS}" -gt 1 ]; then
+  # fail fast on a missing discovery service, like reference entrypoint.sh:14-22
+  if [ -z "${NF_DISCOVERY_SERVICE:-}" ]; then
+    echo "ERROR: NF_DISCOVERY_SERVICE must be set for REPLICAS=${REPLICAS} > 1" >&2
+    exit 1
+  fi
+  HOSTNAME="${HOSTNAME:-$(hostname)}"
+  PROCESS_ID="${PROCESS_ID:-${HOSTNAME##*-}}"
+  case "${PROCESS_ID}" in
+    ''|*[!0-9]*)
+      echo "ERROR: cannot derive numeric PROCESS_ID from hostname '${HOSTNAME}'" >&2
+      exit 1
+      ;;
+  esac
+  BASE_NAME="${HOSTNAME%-*}"
+  COORDINATOR_ADDRESS="${COORDINATOR_ADDRESS:-${BASE_NAME}-0.${NF_DISCOVERY_SERVICE}:${COORDINATOR_PORT}}"
+  export PROCESS_ID COORDINATOR_ADDRESS
+  echo "Starting process ${PROCESS_ID}/${REPLICAS}, coordinator ${COORDINATOR_ADDRESS}"
+else
+  echo "Starting single-host run"
+fi
+
+export REPLICAS COORDINATOR_PORT
+
+# shellcheck disable=SC2086  # SCRIPT_ARGS is intentionally word-split
+exec python "${TRAINING_SCRIPT}" ${SCRIPT_ARGS}

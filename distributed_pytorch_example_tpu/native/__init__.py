@@ -1,7 +1,31 @@
 """Native (C++) components, bound via ctypes with pure-Python fallbacks.
 
-Build with ``make -C distributed_pytorch_example_tpu/native``. Nothing in the
+Build with ``make -C distributed_pytorch_example_tpu/native`` (binding.py
+also auto-builds on first import when g++ is present). Nothing in the
 framework *requires* the native build — every binding has a bit-identical
 Python fallback — mirroring how the reference leans on PyTorch's bundled
 native runtime without authoring native code itself (SURVEY.md §2).
 """
+
+from __future__ import annotations
+
+_binding = None
+_checked = False
+
+
+def get_binding():
+    """The loaded native binding module, or None when unavailable.
+
+    One shared probe (build-once, cache-forever) for every native call site
+    — data/sampler.py and data/synthetic.py dispatch through this.
+    """
+    global _binding, _checked
+    if not _checked:
+        _checked = True
+        try:
+            from distributed_pytorch_example_tpu.native import binding
+
+            _binding = binding
+        except Exception:
+            _binding = None
+    return _binding

@@ -1,0 +1,92 @@
+"""ctypes bindings for the native host-path backend (libdpxnative.so).
+
+Auto-builds the shared library on first import when a toolchain is present
+(g++ is part of the image; pybind11 is not, hence ctypes). Import fails
+cleanly when neither the library nor a compiler exists — callers
+(data/sampler.py, data/synthetic.py) fall back to bit-identical NumPy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libdpxnative.so")
+_SRC = os.path.join(_DIR, "dpxnative.cpp")
+_build_lock = threading.Lock()
+
+
+def _load() -> ctypes.CDLL:
+    with _build_lock:
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-shared",
+                 "-pthread", "-o", _SO, _SRC],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_SO)
+    lib.dpx_permutation.argtypes = [
+        ctypes.c_int64, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int64)
+    ]
+    lib.dpx_permutation.restype = None
+    lib.dpx_gather_rows.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+    ]
+    lib.dpx_gather_rows.restype = None
+    return lib
+
+
+_lib = _load()
+
+
+def permutation(n: int, seed: int) -> np.ndarray:
+    """Deterministic permutation of [0, n) — bit-identical to the NumPy path."""
+    out = np.empty(n, dtype=np.int64)
+    _lib.dpx_permutation(
+        n,
+        ctypes.c_uint64(seed & 0xFFFFFFFFFFFFFFFF),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out
+
+
+def gather_rows(
+    src: np.ndarray, indices: np.ndarray, n_threads: int = 4
+) -> np.ndarray:
+    """dst[r] = src[indices[r]]: threaded batch assembly for wide rows.
+
+    NumPy-compatible indexing: negatives wrap, out-of-range raises — the
+    C++ side does raw memcpy and must never see a bad index.
+    """
+    if not src.flags.c_contiguous:
+        src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    n = src.shape[0]
+    if idx.size and (idx.min() < -n or idx.max() >= n):
+        bad = idx[(idx < -n) | (idx >= n)][0]
+        raise IndexError(
+            f"index {bad} is out of bounds for axis 0 with size {n}"
+        )
+    if idx.size and idx.min() < 0:
+        idx = np.where(idx < 0, idx + n, idx)
+    out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    _lib.dpx_gather_rows(
+        src.ctypes.data_as(ctypes.c_char_p),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out.ctypes.data_as(ctypes.c_char_p),
+        len(idx),
+        row_bytes,
+        n_threads,
+    )
+    return out

@@ -1,0 +1,75 @@
+// Native backend for the host-side data path.
+//
+// The reference leans on PyTorch's bundled C++ runtime for its host work
+// (DataLoader workers, ATen) without authoring native code (SURVEY.md §2);
+// here the host-side hot paths are authored directly:
+//
+//  - dpx_permutation: SplitMix64-seeded Fisher-Yates, bit-identical to the
+//    NumPy fallback in data/sampler.py (_permutation_numpy) so shuffles are
+//    reproducible across backends, hosts, and runs.
+//  - dpx_gather_rows: multi-threaded row gather (batch assembly from a
+//    dataset array by index list) — parallel memcpy beats single-threaded
+//    fancy-indexing for the wide rows of image datasets.
+//
+// Build: make -C distributed_pytorch_example_tpu/native
+// ABI: plain C, loaded via ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <utility>
+#include <vector>
+
+namespace {
+
+inline uint64_t splitmix_scramble(uint64_t x) {
+  uint64_t z = x;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fisher-Yates with one SplitMix64 draw per position, descending swaps.
+// Draw for position i (i = n-1 .. 1) is scramble(seed + i * GOLDEN), taken
+// mod (i+1) — exactly _permutation_numpy in data/sampler.py.
+void dpx_permutation(int64_t n, uint64_t seed, int64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  constexpr uint64_t kGolden = 0x9E3779B97F4A7C15ULL;
+  for (int64_t i = n - 1; i >= 1; --i) {
+    uint64_t x = seed + static_cast<uint64_t>(i) * kGolden;
+    uint64_t j = splitmix_scramble(x) % static_cast<uint64_t>(i + 1);
+    std::swap(out[i], out[static_cast<int64_t>(j)]);
+  }
+}
+
+// Gather rows: dst[r] = src[idx[r]] for r in [0, n_rows), row_bytes each.
+// Threaded over contiguous destination ranges.
+void dpx_gather_rows(const char* src, const int64_t* idx, char* dst,
+                     int64_t n_rows, int64_t row_bytes, int32_t n_threads) {
+  auto copy_range = [=](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      std::memcpy(dst + r * row_bytes, src + idx[r] * row_bytes,
+                  static_cast<size_t>(row_bytes));
+    }
+  };
+  if (n_threads <= 1 || n_rows < 2 * n_threads) {
+    copy_range(0, n_rows);
+    return;
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(static_cast<size_t>(n_threads));
+  int64_t chunk = (n_rows + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n_rows ? lo + chunk : n_rows;
+    if (lo >= hi) break;
+    workers.emplace_back(copy_range, lo, hi);
+  }
+  for (auto& w : workers) w.join();
+}
+
+}  // extern "C"

@@ -58,22 +58,8 @@ def ring_attention(
     batch, s_loc, heads, head_dim = q.shape
     qf = q.astype(jnp.float32)
 
-    m0 = jnp.full((batch, s_loc, heads, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((batch, s_loc, heads, 1), jnp.float32)
-    acc0 = jnp.zeros((batch, s_loc, heads, head_dim), jnp.float32)
-    # mark the constant carries as device-varying so the scan carry type
-    # matches the (varying) per-step outputs under shard_map's vma tracking
-    _vary = getattr(lax, "pcast", None)
-    if _vary is not None:
-        mark = lambda x: _vary(x, tuple(jax.typeof(q).vma), to="varying")  # noqa: E731
-    else:  # older jax
-        mark = lambda x: lax.pvary(x, tuple(jax.typeof(q).vma))  # noqa: E731
-    m0, l0, acc0 = jax.tree_util.tree_map(mark, (m0, l0, acc0))
-    shift = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
-
-    def body(carry, step):
-        k_cur, v_cur, m, l, acc = carry
-        src = (idx - step) % n_chunks  # ring owner of the chunk we now hold
+    def fold_chunk(m, l, acc, k_cur, v_cur, src):
+        """Fold one K/V chunk into the running online softmax."""
         logits = jnp.einsum(
             "bqnh,bknh->bqnk", qf, k_cur.astype(jnp.float32)
         ) * softmax_scale
@@ -94,15 +80,42 @@ def ring_attention(
         acc_new = acc * alpha + jnp.einsum(
             "bqnk,bknh->bqnh", p, v_cur.astype(jnp.float32)
         )
-        # rotate K/V to the next ring neighbor; independent of this step's
-        # attention math, so XLA overlaps the transfer with the compute
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((batch, s_loc, heads, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((batch, s_loc, heads, 1), jnp.float32)
+    acc0 = jnp.zeros((batch, s_loc, heads, head_dim), jnp.float32)
+    # mark the constant carries as device-varying so the scan carry type
+    # matches the (varying) per-step outputs under shard_map's vma tracking
+    _pcast = getattr(lax, "pcast", None)
+    if _pcast is not None:
+        mark = lambda x: _pcast(x, tuple(jax.typeof(q).vma), to="varying")  # noqa: E731
+    else:  # older jax
+        mark = lambda x: lax.pvary(x, tuple(jax.typeof(q).vma))  # noqa: E731
+    m0, l0, acc0 = jax.tree_util.tree_map(mark, (m0, l0, acc0))
+    shift = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
+
+    def body(carry, step):
+        k_cur, v_cur, m, l, acc = carry
+        # start rotating the chunk we hold, then fold it: the transfer has
+        # no dependence on the fold, so XLA overlaps them
         k_nxt = lax.ppermute(k_cur, axis_name, shift)
         v_nxt = lax.ppermute(v_cur, axis_name, shift)
-        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+        src = (idx - step) % n_chunks  # ring owner of the chunk we hold
+        m, l, acc = fold_chunk(m, l, acc, k_cur, v_cur, src)
+        return (k_nxt, v_nxt, m, l, acc), None
 
-    (_, _, m, l, acc), _ = lax.scan(
-        body, (k, v, m0, l0, acc0), jnp.arange(n_chunks)
-    )
+    if n_chunks > 1:
+        # scan folds chunks 0..n-2 with rotation; the last chunk folds
+        # outside so the ring makes exactly n-1 transfers (none discarded)
+        (k_last, v_last, m, l, acc), _ = lax.scan(
+            body, (k, v, m0, l0, acc0), jnp.arange(n_chunks - 1)
+        )
+        m, l, acc = fold_chunk(
+            m, l, acc, k_last, v_last, (idx - (n_chunks - 1)) % n_chunks
+        )
+    else:
+        m, l, acc = fold_chunk(m0, l0, acc0, k, v, idx)
     safe_l = jnp.where(l == 0.0, 1.0, l)
     return (acc / safe_l).astype(q.dtype)
 
@@ -115,17 +128,25 @@ def ring_attention_sharded(
     *,
     seq_axis: str = "sequence",
     batch_axes: Sequence[str] = ("data", "fsdp"),
+    heads_axis: str = "tensor",
     causal: bool = False,
     softmax_scale: Optional[float] = None,
 ) -> jax.Array:
     """Ring attention on global (B, S, N, H) arrays: shard, ring, unshard.
 
     The batch dim shards over ``batch_axes``, the sequence dim over
-    ``seq_axis``; jit composes this with the surrounding program's shardings
-    so no resharding happens when activations already live in this layout.
+    ``seq_axis``, and — when the mesh spans a ``heads_axis`` (tensor
+    parallelism) and the head count divides — the heads dim over it, so
+    TP+SP runs each head group once instead of all-gathering heads and
+    computing them redundantly per tensor replica. jit composes these specs
+    with the surrounding program's shardings.
     """
     batch_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
-    spec = P(batch_axes, seq_axis, None, None)
+    heads = q.shape[2]
+    use_heads_axis = (
+        mesh.shape.get(heads_axis, 1) > 1 and heads % mesh.shape[heads_axis] == 0
+    )
+    spec = P(batch_axes, seq_axis, heads_axis if use_heads_axis else None, None)
     fn = jax.shard_map(
         functools.partial(
             ring_attention,

@@ -1,0 +1,108 @@
+"""Launcher contract tests: hostname→topology derivation (SURVEY.md §4).
+
+Runs the real entrypoint.sh with a stub training script that dumps the env
+it would hand to ``jax.distributed.initialize`` via resolve_config.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributed_pytorch_example_tpu.runtime.distributed import (
+    derive_coordinator_address,
+    derive_process_id,
+    resolve_config,
+)
+
+ENTRYPOINT = os.path.join(
+    os.path.dirname(__file__), "..",
+    "distributed_pytorch_example_tpu", "launch", "entrypoint.sh",
+)
+
+
+def run_entrypoint(env_extra, tmp_path):
+    stub = tmp_path / "stub.py"
+    stub.write_text(
+        "import json, os\n"
+        "print(json.dumps({k: os.environ.get(k) for k in "
+        "('PROCESS_ID', 'COORDINATOR_ADDRESS', 'REPLICAS')}))\n"
+    )
+    env = {
+        "PATH": os.environ["PATH"],
+        "TRAINING_SCRIPT": str(stub),
+        **env_extra,
+    }
+    proc = subprocess.run(
+        ["bash", ENTRYPOINT], env=env, capture_output=True, text=True, timeout=30
+    )
+    assert proc.returncode == 0, proc.stderr
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_single_host_no_env_needed(tmp_path):
+    out = run_entrypoint({}, tmp_path)
+    assert out["REPLICAS"] == "1"
+    assert out["PROCESS_ID"] is None  # resolve_config defaults to 0
+
+
+def test_multi_host_derivation(tmp_path):
+    out = run_entrypoint(
+        {"REPLICAS": "4", "HOSTNAME": "trainer-3",
+         "NF_DISCOVERY_SERVICE": "svc.ns.local"},
+        tmp_path,
+    )
+    assert out["PROCESS_ID"] == "3"
+    assert out["COORDINATOR_ADDRESS"] == "trainer-0.svc.ns.local:29500"
+
+
+def test_multi_host_missing_discovery_fails_fast(tmp_path):
+    stub = tmp_path / "stub.py"
+    stub.write_text("print('should not run')\n")
+    proc = subprocess.run(
+        ["bash", ENTRYPOINT],
+        env={"PATH": os.environ["PATH"], "REPLICAS": "2",
+             "TRAINING_SCRIPT": str(stub), "HOSTNAME": "x-1"},
+        capture_output=True, text=True, timeout=30,
+    )
+    assert proc.returncode == 1
+    assert "NF_DISCOVERY_SERVICE" in proc.stderr
+
+
+def test_non_numeric_hostname_fails_fast(tmp_path):
+    proc = subprocess.run(
+        ["bash", ENTRYPOINT],
+        env={"PATH": os.environ["PATH"], "REPLICAS": "2",
+             "NF_DISCOVERY_SERVICE": "svc", "HOSTNAME": "nosuffix",
+             "TRAINING_SCRIPT": "unused.py"},
+        capture_output=True, text=True, timeout=30,
+    )
+    assert proc.returncode == 1
+    assert "PROCESS_ID" in proc.stderr
+
+
+def test_python_side_derivation_matches_shell():
+    """resolve_config derives the same topology as entrypoint.sh."""
+    assert derive_process_id("worker-7") == 7
+    assert derive_process_id("nosuffix") == 0
+    assert (
+        derive_coordinator_address("myjob-3", "svc", 29500)
+        == "myjob-0.svc:29500"
+    )
+    cfg = resolve_config(
+        {"REPLICAS": "4", "HOSTNAME": "myjob-2", "NF_DISCOVERY_SERVICE": "svc"}
+    )
+    assert cfg.process_id == 2
+    assert cfg.num_processes == 4
+    assert cfg.coordinator_address == "myjob-0.svc:29500"
+
+
+def test_custom_port(tmp_path):
+    out = run_entrypoint(
+        {"REPLICAS": "2", "HOSTNAME": "w-1", "NF_DISCOVERY_SERVICE": "d",
+         "COORDINATOR_PORT": "12345"},
+        tmp_path,
+    )
+    assert out["COORDINATOR_ADDRESS"] == "w-0.d:12345"

@@ -1,0 +1,69 @@
+"""Native backend: bit-identical determinism and gather correctness.
+
+The native library auto-builds on import (g++ is in the image); if the
+toolchain is genuinely absent these tests skip and the NumPy fallbacks
+carry the contract.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_pytorch_example_tpu.data.sampler import _permutation_numpy
+
+binding = pytest.importorskip(
+    "distributed_pytorch_example_tpu.native.binding",
+    reason="native toolchain unavailable",
+)
+
+
+@pytest.mark.parametrize("n", [0, 1, 2, 7, 128, 10_000])
+@pytest.mark.parametrize("seed", [0, 1, 123456789])
+def test_permutation_bit_identical_to_numpy(n, seed):
+    np.testing.assert_array_equal(
+        binding.permutation(n, seed), _permutation_numpy(n, seed)
+    )
+
+
+def test_permutation_is_a_permutation():
+    perm = binding.permutation(1000, 42)
+    assert sorted(perm.tolist()) == list(range(1000))
+
+
+@pytest.mark.parametrize("n_threads", [1, 4])
+def test_gather_rows_matches_fancy_index(n_threads):
+    rng = np.random.default_rng(0)
+    src = rng.standard_normal((100, 32, 32, 3)).astype(np.float32)
+    idx = rng.integers(0, 100, 37)
+    np.testing.assert_array_equal(
+        binding.gather_rows(src, idx, n_threads=n_threads), src[idx]
+    )
+
+
+def test_gather_rows_int_dtype():
+    rng = np.random.default_rng(1)
+    src = rng.integers(0, 50000, (64, 512)).astype(np.int32)
+    idx = rng.integers(0, 64, 16)
+    np.testing.assert_array_equal(binding.gather_rows(src, idx), src[idx])
+
+
+def test_dataset_get_batch_uses_wide_row_path():
+    """get_batch through _gather equals fancy indexing on image-sized rows."""
+    from distributed_pytorch_example_tpu.data.synthetic import SyntheticImageDataset
+
+    ds = SyntheticImageDataset(num_samples=50, image_size=32)
+    idx = np.asarray([3, 1, 4, 1, 5, 9, 2, 6])
+    batch = ds.get_batch(idx)
+    np.testing.assert_array_equal(batch["x"], ds.arrays["x"][idx])
+    np.testing.assert_array_equal(batch["y"], ds.arrays["y"][idx])
+
+
+def test_gather_rows_numpy_indexing_semantics():
+    """Negatives wrap, out-of-range raises — matching the NumPy path."""
+    src = np.arange(8 * 1024, dtype=np.float32).reshape(8, 1024)
+    np.testing.assert_array_equal(
+        binding.gather_rows(src, np.asarray([-1, -8])), src[[-1, -8]]
+    )
+    with pytest.raises(IndexError):
+        binding.gather_rows(src, np.asarray([8]))
+    with pytest.raises(IndexError):
+        binding.gather_rows(src, np.asarray([-9]))
