@@ -21,18 +21,34 @@ _SRC = os.path.join(_DIR, "dpxnative.cpp")
 _build_lock = threading.Lock()
 
 
+def _build() -> None:
+    """Compile via the Makefile (single source of truth for flags) to a
+    temp name, then atomically rename — concurrent builders each produce a
+    complete .so and the loser's rename just re-installs identical bits."""
+    tmp = f"{_SO}.build.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR, f"SO={os.path.basename(tmp)}"],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, _SO)
+    except (OSError, subprocess.CalledProcessError) as e:
+        # optional component: surface as ImportError so callers (and
+        # pytest.importorskip) treat "no toolchain" as absence, not a crash
+        raise ImportError(f"native build failed: {e}") from e
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def _load() -> ctypes.CDLL:
     with _build_lock:
         if not os.path.exists(_SO) or (
             os.path.exists(_SRC)
             and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
         ):
-            subprocess.run(
-                ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-shared",
-                 "-pthread", "-o", _SO, _SRC],
-                check=True,
-                capture_output=True,
-            )
+            _build()
         lib = ctypes.CDLL(_SO)
     lib.dpx_permutation.argtypes = [
         ctypes.c_int64, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int64)

@@ -1,0 +1,63 @@
+"""Profiling hooks: XLA trace capture around a training-step window.
+
+The reference has no profiler (SURVEY.md §5 "Tracing / profiling: ABSENT" —
+only wall-clock epoch timing, reference train.py:265,283). Here tracing is a
+first-class option: a ``StepProfiler`` arms on a step window and captures an
+XLA/TensorBoard trace (HLO timelines, per-op device time) via
+``jax.profiler`` — the tool that actually explains TPU step time.
+
+Host 0 profiles; other processes no-op (one trace per job).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from distributed_pytorch_example_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class StepProfiler:
+    """Captures a device trace for global steps [start, stop).
+
+    Drive it from the training loop: ``profiler.step(global_step)`` once per
+    step; trace starts when the window opens and stops when it closes (or at
+    ``close()`` if the run ends early).
+    """
+
+    def __init__(
+        self,
+        logdir: Optional[str],
+        window: Tuple[int, int] = (10, 13),
+        process_index: int = 0,
+    ):
+        self.logdir = logdir if process_index == 0 else None
+        self.start_step, self.stop_step = window
+        self._active = False
+
+    def step(self, global_step: int) -> None:
+        if self.logdir is None:
+            return
+        if not self._active and self.start_step <= global_step < self.stop_step:
+            import jax
+
+            os.makedirs(self.logdir, exist_ok=True)
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+            logger.info("Profiler trace started at step %d -> %s",
+                        global_step, self.logdir)
+        elif self._active and global_step >= self.stop_step:
+            self._stop()
+
+    def _stop(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+        self._active = False
+        logger.info("Profiler trace written to %s", self.logdir)
+
+    def close(self) -> None:
+        if self._active:
+            self._stop()

@@ -52,6 +52,9 @@ class Trainer:
         checkpoint_dir: Optional[str] = None,
         log_every: int = 10,
         seed: int = 0,
+        metrics_file: Optional[str] = None,
+        profile_dir: Optional[str] = None,
+        profile_window: tuple = (10, 13),
     ):
         self.model = model
         self.task = task
@@ -64,6 +67,13 @@ class Trainer:
         self.eval_step = build_eval_step(model, task)
         self.state: Optional[TrainState] = None
         self.state_shardings = None
+        if metrics_file is None and checkpoint_dir:
+            metrics_file = os.path.join(checkpoint_dir, "metrics.jsonl")
+        self._metrics_file = metrics_file
+        self._profile_dir = profile_dir
+        self._profile_window = profile_window
+        self._profiler = None  # armed in fit()
+        self._global_step = 0
 
     def _mesh_ctx(self):
         """Enter the partitioner's mesh so mesh-aware ops (ring attention)
@@ -101,8 +111,11 @@ class Trainer:
         acc = MetricAccumulator()
         num_batches = len(loader)
         for batch_idx, batch in enumerate(loader):
+            if self._profiler is not None:
+                self._profiler.step(self._global_step)
             with self._mesh_ctx():
                 self.state, metrics = self.train_step(self.state, batch)
+            self._global_step += 1
             acc.append(metrics)
             if batch_idx % self.log_every == 0 and dist.is_coordinator():
                 logger.info(
@@ -136,9 +149,26 @@ class Trainer:
         if self.checkpoint_dir and dist.is_coordinator():
             os.makedirs(self.checkpoint_dir, exist_ok=True)
 
+        from distributed_pytorch_example_tpu.runtime.profiler import StepProfiler
+        from distributed_pytorch_example_tpu.train.metrics_writer import MetricsWriter
+
+        self._profiler = (
+            StepProfiler(
+                self._profile_dir, self._profile_window, dist.process_index()
+            )
+            if self._profile_dir
+            else None
+        )
+        resuming = bool(resume and os.path.exists(resume))
+        writer = MetricsWriter(
+            self._metrics_file,
+            enabled=dist.is_coordinator(),
+            append=resuming,  # fresh runs truncate; resume continues the file
+        )
+
         start_epoch = 0
         best_accuracy = 0.0
-        if resume and os.path.exists(resume):
+        if resuming:
             self.state, saved_epoch, extra = ckpt_lib.load_checkpoint(
                 resume, self.state, self.state_shardings
             )
@@ -149,23 +179,64 @@ class Trainer:
         history: List[Dict[str, float]] = []
         start_time = time.time()
 
+        try:
+            history = self._epoch_loop(
+                train_loader, val_loader, start_epoch, epochs,
+                best_accuracy, writer,
+            )
+        finally:
+            # an exception mid-window must not leave a dangling active
+            # jax trace or an unflushed metrics file
+            if self._profiler is not None:
+                self._profiler.close()
+            writer.close()
+
+        total_time = time.time() - start_time
+        if dist.is_coordinator():
+            logger.info("Training completed in %.2fs", total_time)
+            if val_loader is not None and history:
+                logger.info(
+                    "Best validation accuracy: %.2f%%",
+                    max(h["val_accuracy"] for h in history),
+                )
+        return history
+
+    def _epoch_loop(
+        self, train_loader, val_loader, start_epoch, epochs,
+        best_accuracy, writer,
+    ) -> List[Dict[str, float]]:
+        history: List[Dict[str, float]] = []
         for epoch in range(start_epoch, epochs):
             epoch_start = time.time()
             train_metrics = self.train_epoch(train_loader, epoch)
+            train_time = time.time() - epoch_start
             val_metrics = self.validate(val_loader) if val_loader is not None else {}
             epoch_time = time.time() - epoch_start
 
+            global_batch = getattr(train_loader, "global_batch_size", None)
             record = {
                 "epoch": epoch,
                 "epoch_time": epoch_time,
+                "train_time": train_time,
                 "train_loss": train_metrics.get("loss", float("nan")),
                 "val_loss": val_metrics.get("loss", float("nan")),
                 "val_accuracy": val_metrics.get("accuracy", float("nan")),
             }
+            if global_batch:
+                # training throughput only: validation time excluded
+                record["samples_per_sec"] = (
+                    len(train_loader) * global_batch / train_time
+                )
             history.append(record)
+            writer.write(record)
 
             if dist.is_coordinator():
                 logger.info("Epoch %d completed in %.2fs", epoch, epoch_time)
+                if "samples_per_sec" in record:
+                    logger.info(
+                        "  Throughput: %.1f samples/sec",
+                        record["samples_per_sec"],
+                    )
                 logger.info("  Train Loss: %.4f", record["train_loss"])
                 if val_loader is not None:
                     logger.info(
@@ -198,10 +269,4 @@ class Trainer:
                     extra,
                 )
             dist.barrier("epoch-end")
-
-        total_time = time.time() - start_time
-        if dist.is_coordinator():
-            logger.info("Training completed in %.2fs", total_time)
-            if val_loader is not None:
-                logger.info("Best validation accuracy: %.2f%%", best_accuracy)
         return history

@@ -55,4 +55,12 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     parser.add_argument("--data-dir", type=str, default=None,
                         help="root for real datasets (cifar10); defaults to "
                         "$DPX_DATA_DIR or ./data")
+    parser.add_argument("--profile-dir", type=str, default=None,
+                        help="capture an XLA trace (TensorBoard format) for "
+                        "the --profile-steps window into this directory")
+    parser.add_argument("--profile-steps", type=str, default="10,13",
+                        help="start,stop global-step window for --profile-dir")
+    parser.add_argument("--metrics-file", type=str, default=None,
+                        help="JSONL epoch-metrics path (default: "
+                        "<checkpoint-dir>/metrics.jsonl)")
     return parser

@@ -1,0 +1,67 @@
+"""Worker process for the true multi-process distributed test.
+
+Launched (not collected) by tests/test_multiprocess.py: two of these rendezvous
+via jax.distributed over localhost (the real runtime.initialize path), train a
+sharded-FSDP MLP for one epoch with cross-process batch sharding, and write a
+gathered single-logical-view checkpoint from process 0.
+
+Topology comes from the same env contract the launcher uses
+(NUM_PROCESSES / PROCESS_ID / COORDINATOR_ADDRESS — runtime/distributed.py).
+"""
+
+import json
+import os
+import sys
+
+# one CPU device per process -> 2 global devices across the job
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=1"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import optax  # noqa: E402
+
+import distributed_pytorch_example_tpu as dpx  # noqa: E402
+
+
+def main():
+    config = dpx.runtime.initialize()
+    assert jax.process_count() == config.num_processes, (
+        jax.process_count(), config.num_processes
+    )
+    mesh = dpx.runtime.make_mesh(dpx.runtime.MeshSpec(data=1, fsdp=-1))
+    partitioner = dpx.parallel.fsdp(mesh)  # params sharded ACROSS processes
+
+    dataset = dpx.data.SyntheticClassificationDataset(num_samples=256, seed=0)
+    loader = dpx.data.DeviceLoader(dataset, 32, mesh=mesh, shuffle=True, seed=0)
+    val = dpx.data.DeviceLoader(
+        dpx.data.SyntheticClassificationDataset(num_samples=64, seed=1),
+        32, mesh=mesh, shuffle=False,
+    )
+
+    trainer = dpx.train.Trainer(
+        dpx.models.SimpleNet(),
+        dpx.train.ClassificationTask(),
+        optax.adam(1e-3),
+        partitioner=partitioner,
+        checkpoint_dir=os.environ["DPX_TEST_CKPT_DIR"],
+        log_every=1000,
+    )
+    history = trainer.fit(loader, val, epochs=1)
+
+    # every process must agree on the global metrics (computed inside jit on
+    # the globally sharded batch)
+    print(json.dumps({
+        "process": jax.process_index(),
+        "n_devices": len(jax.devices()),
+        "train_loss": history[-1]["train_loss"],
+        "val_loss": history[-1]["val_loss"],
+    }))
+    dpx.runtime.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

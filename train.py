@@ -142,6 +142,12 @@ def main():
         "Dataset size: %d, batches per epoch: %d", len(train_ds), len(train_loader)
     )
 
+    try:
+        profile_window = tuple(int(x) for x in args.profile_steps.split(","))
+        if len(profile_window) != 2 or profile_window[0] >= profile_window[1]:
+            raise ValueError
+    except ValueError:
+        parser.error("--profile-steps must be 'start,stop' with start < stop")
     trainer = dpx.train.Trainer(
         model,
         task,
@@ -150,6 +156,9 @@ def main():
         checkpoint_dir=args.checkpoint_dir,
         log_every=args.log_every,
         seed=args.seed,
+        metrics_file=args.metrics_file,
+        profile_dir=args.profile_dir,
+        profile_window=profile_window,
     )
     trainer.fit(
         train_loader,
