@@ -6,9 +6,13 @@ ResNet-50/ImageNet"). The baseline anchor is the north-star threshold: 60%
 of published torch-xla ResNet-50 throughput (~1000 samples/sec/chip on
 v4 in bf16), i.e. 600 samples/sec/chip → ``vs_baseline = value / 600``.
 
+``--model gpt2`` (or bert-base) switches to the LM workload and reports
+tokens/sec/chip instead (BASELINE.json config 5, "tokens/sec stress");
+its anchor is 60% of a published-order GPT-2 torch-xla rate.
+
 Prints exactly ONE JSON line on stdout; all logging goes to stderr.
 
-Usage: python bench.py [--model resnet50] [--batch-per-chip N] [--steps N]
+Usage: python bench.py [--model resnet50|gpt2|...] [--batch-per-chip N]
 """
 
 from __future__ import annotations
@@ -19,13 +23,16 @@ import sys
 import time
 
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 600.0  # 60% of published torch-xla v4
+BASELINE_TOKENS_PER_SEC_PER_CHIP = 30_000.0  # 60% of ~50k tok/s/chip GPT-2
 
 
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="resnet50")
     parser.add_argument("--image-size", type=int, default=224)
-    parser.add_argument("--batch-per-chip", type=int, default=128)
+    parser.add_argument("--seq-len", type=int, default=1024)
+    parser.add_argument("--batch-per-chip", type=int, default=None,
+                        help="default: 128 (vision) or 8 (LM)")
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--steps", type=int, default=20)
     args = parser.parse_args()
@@ -39,6 +46,10 @@ def main():
 
     import distributed_pytorch_example_tpu as dpx
 
+    lm = args.model.startswith(("gpt", "bert"))
+    if args.batch_per_chip is None:
+        args.batch_per_chip = 8 if lm else 128
+
     n_chips = len(jax.devices())
     print(
         f"bench: {args.model} on {n_chips} {jax.devices()[0].platform} "
@@ -48,22 +59,43 @@ def main():
 
     mesh = dpx.runtime.make_mesh()
     partitioner = dpx.parallel.data_parallel(mesh)
-    model = dpx.models.get_model(
-        args.model, num_classes=1000, dtype=jnp.bfloat16
-    )
-    task = dpx.train.ClassificationTask()
+    global_batch = args.batch_per_chip * n_chips
+    rng = np.random.default_rng(0)
+    if lm:
+        model = dpx.models.get_model(args.model, dtype=jnp.bfloat16)
+        seq_len = min(args.seq_len, model.max_len)  # BERT caps at 512
+        if seq_len != args.seq_len:
+            print(
+                f"bench: clamping seq-len {args.seq_len} -> {seq_len} "
+                f"(model max_len)",
+                file=sys.stderr,
+            )
+        args.seq_len = seq_len
+        if args.model.startswith("bert"):
+            task = dpx.train.MLMTask(
+                vocab_size=model.vocab_size, mask_token_id=103
+            )
+        else:
+            task = dpx.train.CausalLMTask()
+        batch_np = {
+            "tokens": rng.integers(
+                0, model.vocab_size, (global_batch, args.seq_len)
+            ).astype(np.int32),
+        }
+    else:
+        model = dpx.models.get_model(
+            args.model, num_classes=1000, dtype=jnp.bfloat16
+        )
+        task = dpx.train.ClassificationTask()
+        batch_np = {
+            "x": rng.standard_normal(
+                (global_batch, args.image_size, args.image_size, 3)
+            ).astype(np.float32),
+            "y": rng.integers(0, 1000, (global_batch,)).astype(np.int32),
+        }
     trainer = dpx.train.Trainer(
         model, task, optax.adam(1e-3), partitioner=partitioner
     )
-
-    global_batch = args.batch_per_chip * n_chips
-    rng = np.random.default_rng(0)
-    batch_np = {
-        "x": rng.standard_normal(
-            (global_batch, args.image_size, args.image_size, 3)
-        ).astype(np.float32),
-        "y": rng.integers(0, 1000, (global_batch,)).astype(np.int32),
-    }
     sharding = partitioner.batch_sharding()
     batch = {
         k: jax.make_array_from_process_local_data(sharding, v)
@@ -71,7 +103,7 @@ def main():
     }
 
     with mesh:
-        trainer.init(batch["x"])
+        trainer.init(batch["tokens" if lm else "x"])
         state = trainer.state
         for _ in range(args.warmup):
             state, metrics = trainer.train_step(state, batch)
@@ -87,7 +119,14 @@ def main():
         elapsed = time.perf_counter() - t0
 
     samples_per_sec = global_batch * args.steps / elapsed
-    per_chip = samples_per_sec / n_chips
+    if lm:
+        rate = samples_per_sec * args.seq_len / n_chips  # tokens/sec/chip
+        metric, unit = f"{args.model}_tokens_per_sec_per_chip", "tokens/sec/chip"
+        baseline = BASELINE_TOKENS_PER_SEC_PER_CHIP
+    else:
+        rate = samples_per_sec / n_chips
+        metric, unit = f"{args.model}_samples_per_sec_per_chip", "samples/sec/chip"
+        baseline = BASELINE_SAMPLES_PER_SEC_PER_CHIP
     print(
         f"bench: {elapsed:.2f}s for {args.steps} steps "
         f"({samples_per_sec:.1f} samples/s total)",
@@ -96,10 +135,10 @@ def main():
     print(
         json.dumps(
             {
-                "metric": f"{args.model}_samples_per_sec_per_chip",
-                "value": round(per_chip, 2),
-                "unit": "samples/sec/chip",
-                "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
+                "metric": metric,
+                "value": round(rate, 2),
+                "unit": unit,
+                "vs_baseline": round(rate / baseline, 3),
             }
         )
     )

@@ -72,6 +72,10 @@ class BertBase(nn.Module):
         x = nn.Dense(self.model_dim, dtype=self.dtype, name="mlm_dense")(x)
         x = nn.gelu(x)
         x = nn.LayerNorm(epsilon=1e-12, dtype=self.dtype, name="mlm_ln")(x)
-        logits = x.astype(jnp.float32) @ embed.embedding.T.astype(jnp.float32)
+        from distributed_pytorch_example_tpu.models.transformer import (
+            tied_head_logits,
+        )
+
+        logits = tied_head_logits(x, embed.embedding, self.dtype)
         bias = self.param("mlm_bias", nn.initializers.zeros_init(), (self.vocab_size,))
         return logits + bias

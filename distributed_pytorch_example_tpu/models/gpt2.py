@@ -68,5 +68,8 @@ class GPT2(nn.Module):
             name="decoder",
         )(x, train=train)
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="final_ln")(x)
-        # weight-tied LM head; float32 logits for a stable softmax
-        return x.astype(jnp.float32) @ embed.embedding.T.astype(jnp.float32)
+        from distributed_pytorch_example_tpu.models.transformer import (
+            tied_head_logits,
+        )
+
+        return tied_head_logits(x, embed.embedding, self.dtype)

@@ -21,9 +21,23 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from distributed_pytorch_example_tpu.ops.attention import dot_product_attention
+
+
+def tied_head_logits(x, embedding, dtype) -> jax.Array:
+    """LM-head logits against a tied embedding matrix.
+
+    bf16 operands on the MXU with float32 accumulation: float32 logits for
+    a stable softmax at bf16 matmul speed. Shared by GPT-2 and BERT.
+    """
+    return jax.lax.dot_general(
+        x, embedding.astype(dtype),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
 
 
 class MultiHeadAttention(nn.Module):

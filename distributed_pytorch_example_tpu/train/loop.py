@@ -179,8 +179,9 @@ class Trainer:
         history: List[Dict[str, float]] = []
         start_time = time.time()
 
+        self._global_step = 0  # profile window is per-fit, not per-Trainer
         try:
-            history = self._epoch_loop(
+            history, best_accuracy = self._epoch_loop(
                 train_loader, val_loader, start_epoch, epochs,
                 best_accuracy, writer,
             )
@@ -194,17 +195,16 @@ class Trainer:
         total_time = time.time() - start_time
         if dist.is_coordinator():
             logger.info("Training completed in %.2fs", total_time)
-            if val_loader is not None and history:
-                logger.info(
-                    "Best validation accuracy: %.2f%%",
-                    max(h["val_accuracy"] for h in history),
-                )
+            if val_loader is not None:
+                # best_accuracy carries across resume (checkpoint extra)
+                logger.info("Best validation accuracy: %.2f%%", best_accuracy)
         return history
 
     def _epoch_loop(
         self, train_loader, val_loader, start_epoch, epochs,
         best_accuracy, writer,
-    ) -> List[Dict[str, float]]:
+    ):
+        """Runs epochs; returns (history, best_accuracy-so-far incl. resume)."""
         history: List[Dict[str, float]] = []
         for epoch in range(start_epoch, epochs):
             epoch_start = time.time()
@@ -269,4 +269,4 @@ class Trainer:
                     extra,
                 )
             dist.barrier("epoch-end")
-        return history
+        return history, best_accuracy

@@ -9,6 +9,7 @@ plotted, or regression-checked without log parsing.
 from __future__ import annotations
 
 import json
+import math
 import os
 from typing import Any, Dict, Optional
 
@@ -32,7 +33,14 @@ class MetricsWriter:
     def write(self, record: Dict[str, Any]) -> None:
         if self._fh is None:
             return
-        self._fh.write(json.dumps(record, sort_keys=True) + "\n")
+        # NaN/Inf are not valid JSON: drop absent metrics instead of
+        # emitting tokens strict parsers (jq, JSON.parse) reject
+        clean = {
+            k: v
+            for k, v in record.items()
+            if not (isinstance(v, float) and not math.isfinite(v))
+        }
+        self._fh.write(json.dumps(clean, sort_keys=True, allow_nan=False) + "\n")
 
     def close(self) -> None:
         if self._fh is not None:

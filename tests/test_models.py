@@ -178,3 +178,37 @@ class TestTensorParallel:
 
         out = jax.jit(lambda v, t: model.apply(v, t, train=False))(sharded_vars, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-4)
+
+
+class TestSpaceToDepthStem:
+    def test_bit_equivalent_to_standard_stem(self):
+        """s2d stem with copied 7x7 weights == standard 7x7/s2 SAME conv."""
+        from distributed_pytorch_example_tpu.models.resnet import ResNet50
+
+        x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((2, 224, 224, 3)),
+            jnp.float32,
+        )
+        std = ResNet50(num_classes=10)
+        s2d = ResNet50(num_classes=10, space_to_depth_stem=True)
+        v_std = std.init(jax.random.key(0), x, train=False)
+        v_s2d = s2d.init(jax.random.key(0), x, train=False)
+        # graft the standard stem weights into the s2d variant
+        v_s2d["params"]["stem_conv_kernel"] = v_std["params"]["stem_conv"]["kernel"]
+        for k in v_std["params"]:
+            if k not in ("stem_conv",):
+                v_s2d["params"][k] = v_std["params"][k]
+        v_s2d["batch_stats"] = v_std["batch_stats"]
+        out_std = std.apply(v_std, x, train=False)
+        out_s2d = s2d.apply(v_s2d, x, train=False)
+        np.testing.assert_allclose(
+            np.asarray(out_s2d), np.asarray(out_std), atol=1e-4
+        )
+
+    def test_param_count_unchanged(self):
+        from distributed_pytorch_example_tpu.models.resnet import ResNet50
+
+        x = jnp.zeros((1, 224, 224, 3), jnp.float32)
+        assert n_params(ResNet50(), x) == n_params(
+            ResNet50(space_to_depth_stem=True), x
+        )
