@@ -18,3 +18,7 @@ from distributed_pytorch_example_tpu.data.synthetic import (  # noqa: F401
 from distributed_pytorch_example_tpu.data.loader import (  # noqa: F401
     DeviceLoader,
 )
+from distributed_pytorch_example_tpu.data.text import (  # noqa: F401
+    TokenWindowDataset,
+    load_token_file,
+)

@@ -1,0 +1,84 @@
+"""Tokenized-text datasets from local files (zero-egress environment).
+
+For the LM configs (BERT MLM / GPT-2, BASELINE.json configs 4-5) on real
+corpora: a flat array of token ids on disk (.npy int array, or raw .bin of
+uint16/int32 — the common GPT-2-style preprocessing output) is windowed
+into fixed-length sequences. Loss-specific processing (MLM masking,
+next-token shift) stays on-device in the jitted step, so this loader only
+ships raw ids.
+
+No downloading/tokenizing here: if the file is absent the loader raises
+with guidance to use ``--dataset synthetic-tokens``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class TokenWindowDataset:
+    """Fixed-length windows over a flat token-id array.
+
+    Windows are non-overlapping and start-aligned (``stride == seq_len``
+    default); sample ``i`` is ``ids[i*stride : i*stride + seq_len]``.
+    Map-style with vectorized ``get_batch`` like every dataset here.
+    """
+
+    def __init__(self, ids: np.ndarray, seq_len: int, stride: Optional[int] = None):
+        if ids.ndim != 1:
+            raise ValueError(f"expected flat token array, got shape {ids.shape}")
+        # keep the source array as-is (it may be a memmap over a multi-GB
+        # corpus); windows convert to int32 at gather time
+        self.ids = ids
+        self.seq_len = seq_len
+        self.stride = stride or seq_len
+        n = (len(self.ids) - seq_len) // self.stride + 1
+        if n <= 0:
+            raise ValueError(
+                f"corpus of {len(self.ids)} tokens shorter than one "
+                f"window of {seq_len}"
+            )
+        self._len = n
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __getitem__(self, idx: int):
+        lo = idx * self.stride
+        return {"tokens": np.asarray(self.ids[lo : lo + self.seq_len], np.int32)}
+
+    def get_batch(self, indices: Sequence[int]):
+        idx = np.asarray(indices, dtype=np.int64)
+        starts = idx * self.stride
+        # windowed gather: (batch, seq_len) from a flat array
+        offsets = np.arange(self.seq_len, dtype=np.int64)
+        out = self.ids[starts[:, None] + offsets[None, :]]
+        return {"tokens": np.asarray(out, np.int32)}
+
+
+def load_token_file(
+    path: str,
+    seq_len: int,
+    dtype: str = "uint16",
+    stride: Optional[int] = None,
+) -> TokenWindowDataset:
+    """Load a tokenized corpus from ``.npy`` or raw ``.bin``.
+
+    ``.bin`` files are raw little-endian arrays of ``dtype`` (uint16 covers
+    GPT-2's 50257 vocab — the standard nanoGPT-style preprocessing output).
+    Both formats are memory-mapped, so multi-GB corpora never fully load;
+    pages fault in as windows are gathered.
+    """
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"Token file {path!r} not found. This environment has no network "
+            "egress — pre-tokenize offline, or use --dataset synthetic-tokens."
+        )
+    if path.endswith(".npy"):
+        ids = np.load(path, mmap_mode="r")
+    else:
+        ids = np.memmap(path, dtype=np.dtype(dtype), mode="r")
+    return TokenWindowDataset(ids, seq_len=seq_len, stride=stride)

@@ -31,8 +31,12 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     parser.add_argument("--model", type=str, default="mlp",
                         help="mlp|resnet18|resnet50|vit-b16|bert-base|gpt2")
     parser.add_argument("--dataset", type=str, default="synthetic",
-                        help="synthetic|synthetic-image|synthetic-tokens|cifar10")
+                        help="synthetic|synthetic-image|synthetic-tokens|"
+                        "cifar10|tokens-file")
     parser.add_argument("--seq-len", type=int, default=512)
+    parser.add_argument("--token-dtype", type=str, default="uint16",
+                        choices=("uint16", "uint32", "int32"),
+                        help="element dtype of raw .bin token files")
     parser.add_argument("--image-size", type=int, default=32)
     parser.add_argument("--num-classes", type=int, default=10)
     parser.add_argument("--seed", type=int, default=0)

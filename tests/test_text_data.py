@@ -1,0 +1,75 @@
+"""Tokenized-text dataset: windowing, batch gather, file formats."""
+
+import numpy as np
+import pytest
+
+from distributed_pytorch_example_tpu.data.text import (
+    TokenWindowDataset,
+    load_token_file,
+)
+
+
+def test_windowing_non_overlapping():
+    ids = np.arange(100, dtype=np.int32)
+    ds = TokenWindowDataset(ids, seq_len=32)
+    assert len(ds) == 3  # (100 - 32) // 32 + 1
+    np.testing.assert_array_equal(ds[0]["tokens"], np.arange(32))
+    np.testing.assert_array_equal(ds[2]["tokens"], np.arange(64, 96))
+
+
+def test_windowing_strided_overlap():
+    ids = np.arange(100, dtype=np.int32)
+    ds = TokenWindowDataset(ids, seq_len=32, stride=16)
+    assert len(ds) == (100 - 32) // 16 + 1
+    np.testing.assert_array_equal(ds[1]["tokens"], np.arange(16, 48))
+
+
+def test_get_batch_matches_getitem():
+    ids = np.random.default_rng(0).integers(0, 1000, 500).astype(np.int32)
+    ds = TokenWindowDataset(ids, seq_len=64)
+    batch = ds.get_batch([2, 0, 5])
+    for row, idx in zip(batch["tokens"], [2, 0, 5]):
+        np.testing.assert_array_equal(row, ds[idx]["tokens"])
+
+
+def test_too_short_corpus_raises():
+    with pytest.raises(ValueError, match="shorter"):
+        TokenWindowDataset(np.arange(10, dtype=np.int32), seq_len=32)
+
+
+def test_load_npy_and_bin(tmp_path):
+    ids = np.random.default_rng(1).integers(0, 50000, 1000).astype(np.uint16)
+    np.save(tmp_path / "c.npy", ids)
+    ids.tofile(tmp_path / "c.bin")
+    ds_npy = load_token_file(str(tmp_path / "c.npy"), seq_len=128)
+    ds_bin = load_token_file(str(tmp_path / "c.bin"), seq_len=128)
+    np.testing.assert_array_equal(ds_npy[0]["tokens"], ds_bin[0]["tokens"])
+    # the corpus stays memory-mapped; windows come out int32 for the device
+    assert isinstance(ds_bin.ids, np.memmap)
+    assert ds_npy[0]["tokens"].dtype == np.int32
+    assert ds_npy.get_batch([0])["tokens"].dtype == np.int32
+
+
+def test_load_bin_int32_dtype(tmp_path):
+    ids = np.random.default_rng(3).integers(0, 70000, 500).astype(np.int32)
+    ids.tofile(tmp_path / "c32.bin")
+    ds = load_token_file(str(tmp_path / "c32.bin"), seq_len=64, dtype="int32")
+    np.testing.assert_array_equal(ds[0]["tokens"], ids[:64])
+
+
+def test_missing_file_guidance():
+    with pytest.raises(FileNotFoundError, match="synthetic-tokens"):
+        load_token_file("/nonexistent/train.bin", seq_len=128)
+
+
+def test_loader_integration(devices):
+    """Windows flow through the DeviceLoader sharded pipeline."""
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+    from distributed_pytorch_example_tpu.runtime import make_mesh
+
+    ids = np.random.default_rng(2).integers(0, 100, 2048).astype(np.int32)
+    ds = TokenWindowDataset(ids, seq_len=64)
+    mesh = make_mesh()
+    loader = DeviceLoader(ds, 8, mesh=mesh, num_shards=1, shard_id=0)
+    batch = next(iter(loader))
+    assert batch["tokens"].shape == (8, 64)

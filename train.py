@@ -16,6 +16,7 @@ Model/dataset/mesh selection beyond the reference is via the framework flags
 from __future__ import annotations
 
 import argparse
+import os
 
 import jax.numpy as jnp
 import optax
@@ -50,6 +51,16 @@ def build_dataset(args, num_samples: int, seed: int, train: bool = True):
         from distributed_pytorch_example_tpu.data.vision import load_cifar10
 
         return load_cifar10(train=train, data_dir=args.data_dir)
+    if name == "tokens-file":
+        from distributed_pytorch_example_tpu.data.text import load_token_file
+        from distributed_pytorch_example_tpu.data.vision import _data_root
+
+        fname = "train.bin" if train else "val.bin"
+        return load_token_file(
+            os.path.join(_data_root(args.data_dir), fname),
+            seq_len=args.seq_len,
+            dtype=args.token_dtype,
+        )
     raise ValueError(f"Unknown dataset {name!r}")
 
 
