@@ -32,6 +32,8 @@ class GPT2(nn.Module):
     use_flash: Optional[bool] = None
     seq_axis: Optional[str] = None  # mesh axis for ring attention (SP)
     remat: bool = False
+    moe_experts: int = 0  # >0: MoE MLP on every moe_every-th block
+    moe_every: int = 2
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
@@ -65,6 +67,8 @@ class GPT2(nn.Module):
             use_flash=self.use_flash,
             seq_axis=self.seq_axis,
             remat=self.remat,
+            moe_experts=self.moe_experts,
+            moe_every=self.moe_every,
             name="decoder",
         )(x, train=train)
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="final_ln")(x)

@@ -1,0 +1,117 @@
+"""Mixture-of-Experts MLP with expert parallelism (Switch-style top-1).
+
+Beyond-reference capability (the reference is a dense MLP, SURVEY.md §2):
+scales model capacity by replacing transformer MLPs with E experts of which
+each token uses one. TPU-first design — the GShard/Switch dense-dispatch
+formulation: routing builds (tokens → expert, capacity-slot) one-hot
+dispatch/combine tensors and the whole layer is einsums, so under a mesh
+with the expert dim of the weights sharded on the ``expert`` axis XLA
+partitions the expert computation and inserts the token all-to-alls. No
+gather/scatter, no dynamic shapes, fully jit/remat/grad compatible.
+
+Load-balancing auxiliary loss (Switch Transformer form: E * Σ_e f_e * P_e)
+is emitted via ``self.sow("losses", ...)`` and added to the task loss by
+``train.tasks`` — models stay single-output.
+
+Capacity: each expert processes at most C = ceil(S/E * capacity_factor)
+tokens per batch row; overflow tokens pass through the residual unchanged
+(standard Switch behavior).
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMlpBlock(nn.Module):
+    """Drop-in replacement for models.transformer.MlpBlock."""
+
+    num_experts: int
+    mlp_dim: int
+    model_dim: int
+    capacity_factor: float = 1.25
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    aux_loss_weight: float = 0.01
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        batch, seq, dim = x.shape
+        n_exp = self.num_experts
+        capacity = max(1, math.ceil(seq * self.capacity_factor / n_exp))
+
+        # routing in float32: small tensors, and router stability matters
+        router_logits = nn.Dense(n_exp, dtype=jnp.float32, name="router")(
+            x.astype(jnp.float32)
+        )  # (B, S, E)
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        gate = jnp.max(probs, axis=-1)  # (B, S)
+        expert_idx = jnp.argmax(probs, axis=-1)  # (B, S)
+
+        # Switch load-balancing loss: E * sum_e (token fraction)*(prob mass)
+        onehot = jax.nn.one_hot(expert_idx, n_exp, dtype=jnp.float32)
+        tokens_per_expert = onehot.mean(axis=(0, 1))  # (E,)
+        prob_per_expert = probs.mean(axis=(0, 1))  # (E,)
+        aux = n_exp * jnp.sum(tokens_per_expert * prob_per_expert)
+        self.sow(
+            "losses", "load_balancing",
+            self.aux_loss_weight * aux,
+            reduce_fn=lambda a, b: a + b,
+            init_fn=lambda: jnp.zeros((), jnp.float32),
+        )
+
+        # capacity-slot assignment: position of each token in its expert's
+        # queue along the sequence; tokens past capacity are dropped (they
+        # ride the residual connection)
+        # (cumsum - 1) only at the chosen expert's column, 0 elsewhere
+        position = (jnp.cumsum(onehot, axis=1) - 1.0) * onehot  # (B, S, E)
+        slot = jnp.sum(position, axis=-1)  # (B, S): slot in chosen expert
+        # one_hot is all-zeros for slot >= capacity, which IS the drop
+        dispatch = (
+            onehot[..., None]
+            * jax.nn.one_hot(
+                slot.astype(jnp.int32), capacity, dtype=jnp.float32
+            )[:, :, None, :]
+        )  # (B, S, E, C) one-hot
+        combine = dispatch * gate[:, :, None, None]  # weighted return path
+
+        # expert weights: leading expert dim is the EP sharding target
+        w_up = self.param(
+            "up_kernel",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (n_exp, dim, self.mlp_dim),
+        ).astype(self.dtype)
+        b_up = self.param(
+            "up_bias", nn.initializers.zeros_init(), (n_exp, self.mlp_dim)
+        ).astype(self.dtype)
+        w_down = self.param(
+            "down_kernel",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (n_exp, self.mlp_dim, dim),
+        ).astype(self.dtype)
+        b_down = self.param(
+            "down_bias", nn.initializers.zeros_init(), (n_exp, dim)
+        ).astype(self.dtype)
+
+        # dispatch → expert MLP → combine: all einsums, XLA inserts the
+        # all-to-alls when 'expert' spans devices
+        expert_in = jnp.einsum(
+            "bsec,bsd->ebcd", dispatch.astype(self.dtype), x
+        )  # (E, B, C, D)
+        h = nn.gelu(
+            jnp.einsum("ebcd,edf->ebcf", expert_in, w_up)
+            + b_up[:, None, None, :]
+        )
+        expert_out = (
+            jnp.einsum("ebcf,efd->ebcd", h, w_down) + b_down[:, None, None, :]
+        )
+        out = jnp.einsum(
+            "bsec,ebcd->bsd", combine.astype(self.dtype), expert_out
+        )
+        if self.dropout_rate:
+            out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
+        return out

@@ -153,6 +153,8 @@ class TransformerBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None
     seq_axis: Optional[str] = None
+    moe_experts: int = 0  # >0: Mixture-of-Experts MLP with this many experts
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = False):
@@ -167,13 +169,26 @@ class TransformerBlock(nn.Module):
             seq_axis=self.seq_axis,
             name="attn",
         )
-        mlp = MlpBlock(
-            mlp_dim=self.mlp_dim,
-            model_dim=self.model_dim,
-            dropout_rate=self.dropout_rate,
-            dtype=self.dtype,
-            name="mlp",
-        )
+        if self.moe_experts:
+            from distributed_pytorch_example_tpu.models.moe import MoEMlpBlock
+
+            mlp = MoEMlpBlock(
+                num_experts=self.moe_experts,
+                mlp_dim=self.mlp_dim,
+                model_dim=self.model_dim,
+                capacity_factor=self.moe_capacity_factor,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+                name="moe",
+            )
+        else:
+            mlp = MlpBlock(
+                mlp_dim=self.mlp_dim,
+                model_dim=self.model_dim,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+                name="mlp",
+            )
         ln1 = nn.LayerNorm(epsilon=self.layer_norm_epsilon, dtype=self.dtype, name="ln1")
         ln2 = nn.LayerNorm(epsilon=self.layer_norm_epsilon, dtype=self.dtype, name="ln2")
         if self.prenorm:
@@ -207,10 +222,19 @@ class TransformerStack(nn.Module):
     use_flash: Optional[bool] = None
     seq_axis: Optional[str] = None
     remat: bool = False
+    moe_experts: int = 0
+    moe_every: int = 2  # MoE MLP on every Nth block (Switch uses 2)
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = False):
+        if self.moe_experts > 0 and self.moe_every < 1:
+            raise ValueError(
+                f"moe_every must be >= 1 when moe_experts > 0, got "
+                f"{self.moe_every}"
+            )
         for i in range(self.num_layers):
+            is_moe = self.moe_experts > 0 and i % self.moe_every == self.moe_every - 1
             block = TransformerBlock(
                 num_heads=self.num_heads,
                 head_dim=self.head_dim,
@@ -223,6 +247,8 @@ class TransformerStack(nn.Module):
                 dtype=self.dtype,
                 use_flash=self.use_flash,
                 seq_axis=self.seq_axis,
+                moe_experts=self.moe_experts if is_moe else 0,
+                moe_capacity_factor=self.moe_capacity_factor,
                 name=f"layer_{i}",
             )
             if self.remat:

@@ -40,6 +40,10 @@ TRANSFORMER_TP_RULES: tuple = (
     # row-parallel: shard input dim, replicate bias
     (r"attn/o/kernel$", P("tensor", None)),
     (r"mlp/down/kernel$", P("tensor", None)),
+    # expert parallelism: MoE expert dim sharded on 'expert'; the router
+    # stays replicated (tiny, and every token needs it)
+    (r"moe/(up|down)_kernel$", P("expert", None, None)),
+    (r"moe/(up|down)_bias$", P("expert", None)),
 )
 
 

@@ -13,6 +13,7 @@ Axis vocabulary used across the framework:
   (ZeRO-style); batch is sharded over (data, fsdp) jointly.
 - ``tensor``   — tensor (operator) parallelism inside layers.
 - ``sequence`` — sequence/context parallelism (ring attention).
+- ``expert``   — expert parallelism (MoE layers' expert dim).
 
 ``MeshSpec`` sizes multiply to the device count; -1 means "absorb the rest"
 (at most one axis).
@@ -35,6 +36,7 @@ class MeshSpec:
     fsdp: int = 1
     tensor: int = 1
     sequence: int = 1
+    expert: int = 1
 
     def resolve(self, n_devices: int) -> "MeshSpec":
         sizes = dataclasses.asdict(self)
@@ -56,10 +58,10 @@ class MeshSpec:
 
     @property
     def axis_names(self) -> Sequence[str]:
-        return ("data", "fsdp", "tensor", "sequence")
+        return ("data", "fsdp", "tensor", "sequence", "expert")
 
     def axis_sizes(self) -> Sequence[int]:
-        return (self.data, self.fsdp, self.tensor, self.sequence)
+        return (self.data, self.fsdp, self.tensor, self.sequence, self.expert)
 
 
 def make_mesh(

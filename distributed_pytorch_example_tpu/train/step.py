@@ -46,6 +46,7 @@ def init_state(
             )
         )
         params = variables.pop("params")
+        variables.pop("losses", None)  # sown aux losses are not model state
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,

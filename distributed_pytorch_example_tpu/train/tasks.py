@@ -23,15 +23,26 @@ Metrics = Dict[str, jax.Array]
 
 
 def _apply_model(model, params, model_state, inputs, rng, train: bool):
-    """Run model.apply handling mutable collections + dropout rng."""
+    """Run model.apply handling mutable collections + dropout rng.
+
+    Returns ``(logits, new_model_state, aux_loss)``. In train mode the
+    ``losses`` collection is requested so modules can contribute auxiliary
+    losses via ``self.sow("losses", ...)`` (e.g. MoE load balancing);
+    aux_loss is their sum and is NOT part of the carried model state.
+    """
     variables = {"params": params, **(model_state or {})}
     rngs = {"dropout": rng} if train else {}
-    mutable = list(model_state.keys()) if (train and model_state) else False
-    out = model.apply(variables, inputs, train=train, rngs=rngs, mutable=mutable)
-    if mutable:
-        logits, new_vars = out
-        return logits, dict(new_vars)
-    return out, (model_state or {})
+    if train:
+        mutable = list(model_state.keys()) + ["losses"] if model_state else ["losses"]
+        logits, new_vars = model.apply(
+            variables, inputs, train=train, rngs=rngs, mutable=mutable
+        )
+        new_vars = dict(new_vars)
+        losses = new_vars.pop("losses", {})
+        aux = sum(jax.tree_util.tree_leaves(losses)) if losses else 0.0
+        return logits, (new_vars or (model_state or {})), aux
+    out = model.apply(variables, inputs, train=train, rngs=rngs, mutable=False)
+    return out, (model_state or {}), 0.0
 
 
 class ClassificationTask:
@@ -46,11 +57,13 @@ class ClassificationTask:
     def compute_loss(
         self, model, params, model_state, batch, rng, *, train: bool
     ) -> Tuple[jax.Array, Metrics, Any]:
-        logits, new_ms = _apply_model(model, params, model_state, batch["x"], rng, train)
+        logits, new_ms, aux = _apply_model(
+            model, params, model_state, batch["x"], rng, train
+        )
         labels = batch["y"]
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), labels
-        ).mean()
+        ).mean() + aux
         accuracy = 100.0 * jnp.mean(jnp.argmax(logits, axis=-1) == labels)
         return loss, {"loss": loss, "accuracy": accuracy}, new_ms
 
@@ -69,11 +82,13 @@ class CausalLMTask:
         self, model, params, model_state, batch, rng, *, train: bool
     ) -> Tuple[jax.Array, Metrics, Any]:
         tokens = batch["tokens"]
-        logits, new_ms = _apply_model(model, params, model_state, tokens, rng, train)
+        logits, new_ms, aux = _apply_model(
+            model, params, model_state, tokens, rng, train
+        )
         logits, targets = logits[:, :-1], tokens[:, 1:]
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), targets
-        ).mean()
+        ).mean() + aux
         accuracy = 100.0 * jnp.mean(jnp.argmax(logits, axis=-1) == targets)
         return loss, {"loss": loss, "accuracy": accuracy}, new_ms
 
@@ -110,14 +125,14 @@ class MLMTask:
             jnp.asarray(self.mask_token_id, tokens.dtype),
             jnp.where(selected & (kind >= 0.9), random_tokens, tokens),
         )
-        logits, new_ms = _apply_model(
+        logits, new_ms, aux = _apply_model(
             model, params, model_state, masked_inputs, rng_drop, train
         )
         per_tok = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), tokens
         )
         denom = jnp.maximum(selected.sum(), 1)
-        loss = jnp.where(selected, per_tok, 0.0).sum() / denom
+        loss = jnp.where(selected, per_tok, 0.0).sum() / denom + aux
         correct = jnp.where(selected, jnp.argmax(logits, axis=-1) == tokens, False)
         accuracy = 100.0 * correct.sum() / denom
         return loss, {"loss": loss, "accuracy": accuracy}, new_ms

@@ -1,0 +1,142 @@
+"""MoE layer: routing math, capacity, aux loss, expert parallelism."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_pytorch_example_tpu.models.moe import MoEMlpBlock
+from distributed_pytorch_example_tpu.runtime import MeshSpec, make_mesh
+
+
+def make_block(**kw):
+    defaults = dict(num_experts=4, mlp_dim=64, model_dim=32)
+    defaults.update(kw)
+    return MoEMlpBlock(**defaults)
+
+
+def apply_block(block, x, train=False):
+    variables = block.init(jax.random.key(0), x, train=False)
+    out = block.apply(
+        variables, x, train=train, mutable=["losses"] if train else False
+    )
+    if train:
+        return out  # (y, {"losses": ...})
+    return out, None
+
+
+def test_output_shape_and_finite():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 16, 32)), jnp.float32)
+    out, _ = apply_block(make_block(), x, train=True)
+    assert out.shape == x.shape
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_aux_loss_emitted_and_bounded():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 64, 32)), jnp.float32)
+    block = make_block(aux_loss_weight=1.0)
+    variables = block.init(jax.random.key(0), x, train=False)
+    _, state = block.apply(variables, x, train=True, mutable=["losses"])
+    (aux,) = jax.tree_util.tree_leaves(state["losses"])
+    # Switch aux loss is minimized at 1.0 (uniform routing); random init
+    # should be close to, and never far below, that bound
+    assert 0.9 < float(aux) < 4.0
+
+
+def test_every_surviving_token_routed_once():
+    """With generous capacity, output is each token's gated expert output."""
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((1, 8, 32)), jnp.float32)
+    block = make_block(capacity_factor=8.0)  # capacity >= tokens: no drops
+    variables = block.init(jax.random.key(0), x, train=False)
+    out = block.apply(variables, x, train=False)
+    # manual recompute from the router and expert params
+    p = variables["params"]
+    logits = x @ p["router"]["kernel"] + p["router"]["bias"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(probs, axis=-1)[0]  # (S,)
+    gate = jnp.max(probs, axis=-1)[0]
+    expected = []
+    for t in range(8):
+        e = int(idx[t])
+        h = jax.nn.gelu(x[0, t] @ p["up_kernel"][e] + p["up_bias"][e])
+        expected.append(gate[t] * (h @ p["down_kernel"][e] + p["down_bias"][e]))
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.stack(expected), atol=1e-5
+    )
+
+
+def test_capacity_drops_pass_through_as_zero():
+    """Over-capacity tokens contribute zero from the MoE branch."""
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((1, 64, 32)), jnp.float32)
+    tight = make_block(capacity_factor=0.25)
+    variables = tight.init(jax.random.key(0), x, train=False)
+    out = tight.apply(variables, x, train=False)
+    assert out.shape == x.shape
+    # some rows must be exactly zero (dropped tokens)
+    row_norms = np.linalg.norm(np.asarray(out[0]), axis=-1)
+    assert (row_norms == 0).any()
+
+
+def test_gradients_flow_to_experts_and_router():
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 16, 32)), jnp.float32)
+    block = make_block()
+    variables = block.init(jax.random.key(0), x, train=False)
+
+    def loss_fn(params):
+        out, state = block.apply(
+            {"params": params}, x, train=True, mutable=["losses"]
+        )
+        aux = sum(jax.tree_util.tree_leaves(state["losses"]))
+        return jnp.sum(out**2) + aux
+
+    grads = jax.grad(loss_fn)(variables["params"])
+    for name in ("router", "up_kernel", "down_kernel"):
+        g = grads[name]
+        leaves = jax.tree_util.tree_leaves(g)
+        assert any(float(jnp.abs(l).max()) > 0 for l in leaves), name
+
+
+def test_expert_parallel_matches_single_device(devices):
+    """EP-sharded weights under jit == unsharded reference output."""
+    from distributed_pytorch_example_tpu.parallel.partition import (
+        transformer_partitioner,
+    )
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+
+    mesh = make_mesh(MeshSpec(data=2, expert=4))
+    model = GPT2(vocab_size=101, max_len=32, model_dim=32, num_layers=2,
+                 num_heads=4, mlp_dim=64, moe_experts=4, moe_every=2)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 101, (4, 16)), jnp.int32)
+    variables = model.init(jax.random.key(0), tokens, train=False)
+    expected = model.apply(variables, tokens, train=False)
+
+    part = transformer_partitioner(mesh)
+    specs = part.tree_specs(variables)["params"]["decoder"]["layer_1"]["moe"]
+    assert specs["up_kernel"] == jax.sharding.PartitionSpec("expert", None, None)
+    sharded = jax.device_put(variables, part.tree_shardings(variables))
+    out = jax.jit(lambda v, t: model.apply(v, t, train=False))(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-4)
+
+
+def test_moe_gpt2_trains_end_to_end(devices):
+    """Full Trainer loop with MoE + aux loss on the fake mesh."""
+    import distributed_pytorch_example_tpu as dpx
+
+    mesh = make_mesh(MeshSpec(data=2, expert=4))
+    model = dpx.models.get_model(
+        "gpt2", vocab_size=64, max_len=32, model_dim=32, num_layers=2,
+        num_heads=4, mlp_dim=64, moe_experts=4,
+    )
+    ds = dpx.data.SyntheticTokenDataset(num_samples=32, seq_len=16, vocab_size=64)
+    loader = dpx.data.DeviceLoader(ds, 8, mesh=mesh, num_shards=1, shard_id=0)
+    from distributed_pytorch_example_tpu.parallel.partition import (
+        transformer_partitioner,
+    )
+
+    trainer = dpx.train.Trainer(
+        model, dpx.train.CausalLMTask(), optax.adam(1e-3),
+        partitioner=transformer_partitioner(mesh),
+    )
+    history = trainer.fit(loader, epochs=1)
+    assert np.isfinite(history[-1]["train_loss"])

@@ -92,6 +92,7 @@ def main():
             fsdp=args.mesh_fsdp,
             tensor=args.mesh_tensor,
             sequence=args.mesh_sequence,
+            expert=args.mesh_expert,
         )
     )
     dp_size = dpx.runtime.mesh.data_parallel_size(mesh)
@@ -120,6 +121,8 @@ def main():
             overrides["use_flash"] = args.flash == "on"
         if args.mesh_sequence not in (0, 1):
             overrides["seq_axis"] = "sequence"  # ring attention over the mesh
+    if args.moe_experts and args.model.startswith("gpt"):
+        overrides["moe_experts"] = args.moe_experts
     model = dpx.models.get_model(args.model, **overrides)
     task = build_task(args, model)
 
