@@ -34,6 +34,7 @@ class GPT2(nn.Module):
     remat: bool = False
     moe_experts: int = 0  # >0: MoE MLP on every moe_every-th block
     moe_every: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
@@ -69,6 +70,7 @@ class GPT2(nn.Module):
             remat=self.remat,
             moe_experts=self.moe_experts,
             moe_every=self.moe_every,
+            moe_capacity_factor=self.moe_capacity_factor,
             name="decoder",
         )(x, train=train)
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="final_ln")(x)

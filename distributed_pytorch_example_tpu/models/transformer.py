@@ -24,6 +24,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from distributed_pytorch_example_tpu.models.moe import MoEMlpBlock
 from distributed_pytorch_example_tpu.ops.attention import dot_product_attention
 
 
@@ -170,8 +171,6 @@ class TransformerBlock(nn.Module):
             name="attn",
         )
         if self.moe_experts:
-            from distributed_pytorch_example_tpu.models.moe import MoEMlpBlock
-
             mlp = MoEMlpBlock(
                 num_experts=self.moe_experts,
                 mlp_dim=self.mlp_dim,

@@ -87,12 +87,9 @@ def ring_attention(
     acc0 = jnp.zeros((batch, s_loc, heads, head_dim), jnp.float32)
     # mark the constant carries as device-varying so the scan carry type
     # matches the (varying) per-step outputs under shard_map's vma tracking
-    _pcast = getattr(lax, "pcast", None)
-    if _pcast is not None:
-        mark = lambda x: _pcast(x, tuple(jax.typeof(q).vma), to="varying")  # noqa: E731
-    else:  # older jax
-        mark = lambda x: lax.pvary(x, tuple(jax.typeof(q).vma))  # noqa: E731
-    m0, l0, acc0 = jax.tree_util.tree_map(mark, (m0, l0, acc0))
+    from distributed_pytorch_example_tpu.parallel.api import pvary_like
+
+    m0, l0, acc0 = pvary_like((m0, l0, acc0), q)
     shift = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
 
     def body(carry, step):

@@ -39,6 +39,30 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
+def pvary_like(tree: Any, like: jax.Array, extra_axes: Sequence[str] = ()) -> Any:
+    """Mark constant arrays as device-varying to match ``like``'s vma set.
+
+    Under ``shard_map``, scan carries initialized from constants must carry
+    the same varying-manual-axes type as the per-step outputs derived from
+    sharded inputs; this stamps them (used by ring attention and the
+    pipeline schedule).
+    """
+    from jax import lax
+
+    target = set(jax.typeof(like).vma) | set(extra_axes)
+    pcast = getattr(lax, "pcast", None)
+
+    def mark(x):
+        missing = tuple(target - set(jax.typeof(x).vma))
+        if not missing:
+            return x
+        if pcast is not None:
+            return pcast(x, missing, to="varying")
+        return lax.pvary(x, missing)  # older jax
+
+    return jax.tree_util.tree_map(mark, tree)
+
+
 def shard_largest_axis(axis_name: str, mesh: Mesh) -> Callable[[Tuple[int, ...]], P]:
     """Spec factory: place ``axis_name`` on the leaf's largest divisible dim.
 

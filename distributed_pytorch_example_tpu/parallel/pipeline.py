@@ -1,0 +1,129 @@
+"""GPipe-style pipeline parallelism over a ``pipe`` mesh axis.
+
+Beyond-reference capability (SURVEY.md §2: the reference has DP only).
+Homogeneous stages — each holding an equal slice of a stack of identical
+blocks — live on consecutive devices of the ``pipe`` axis; microbatches
+stream through the classic GPipe schedule: at tick ``t`` stage ``s``
+processes microbatch ``t - s`` and hands its activation to stage ``s + 1``
+via ``lax.ppermute`` (a neighbor ICI transfer). The whole schedule is a
+``lax.scan`` inside ``shard_map``, so it is jit-compatible and reverse-mode
+differentiable — the backward pass replays the pipeline in reverse with the
+transposed permutes, no hand-written adjoint needed.
+
+SPMD realities: every device computes at every tick (inactive ticks produce
+garbage that is never consumed — the activity predicate guarantees a
+receiver only uses data its upstream produced while active), so utilization
+is the usual GPipe ``n_micro / (n_micro + n_stages - 1)``; choose
+``n_micro >> n_stages``. Stage params must be a stacked pytree with leading
+dim ``n_stages``, and the stage function must preserve activation shape.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_pytorch_example_tpu.parallel.api import pvary_like
+
+StageFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def _gpipe_local(stage_params, x_stack, *, stage_fn: StageFn, axis_name: str):
+    """Per-device pipeline program; call under shard_map.
+
+    stage_params: local slice (1, ...) of the stage-stacked params.
+    x_stack: (n_micro, microbatch, ...) — full microbatch stack (the
+    scheduler picks which one this stage consumes at each tick).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = x_stack.shape[0]
+    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    shift = [(i, i + 1) for i in range(n_stages - 1)]
+    n_ticks = n_micro + n_stages - 1
+
+    def tick(carry, t):
+        incoming, outputs = carry
+        # stage 0 feeds from the input stack; later stages from upstream
+        mb_t = lax.dynamic_index_in_dim(
+            x_stack, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+        )
+        x_in = jnp.where(stage == 0, mb_t, incoming)
+        y = stage_fn(params, x_in)
+        active = (t - stage >= 0) & (t - stage < n_micro)
+        # the final stage records its (active) results
+        store = jnp.clip(t - stage, 0, n_micro - 1)
+        updated = lax.dynamic_update_index_in_dim(outputs, y, store, 0)
+        outputs = jnp.where(
+            active & (stage == n_stages - 1), updated, outputs
+        )
+        if n_stages > 1:
+            incoming = lax.ppermute(y, axis_name, shift)
+        return (incoming, outputs), None
+
+    # carries become pipe-varying through the stage params / ppermute, so
+    # the init must carry that vma too (x_stack itself is pipe-replicated)
+    incoming0 = pvary_like(
+        jnp.zeros(x_stack.shape[1:], x_stack.dtype), x_stack, (axis_name,)
+    )
+    outputs0 = pvary_like(jnp.zeros_like(x_stack), x_stack, (axis_name,))
+    (_, outputs), _ = lax.scan(
+        tick, (incoming0, outputs0), jnp.arange(n_ticks)
+    )
+    # only the last stage holds real outputs; reduce to make them uniform
+    outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
+    return lax.psum(outputs, axis_name)
+
+
+def gpipe(
+    stage_fn: StageFn,
+    stage_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    n_micro: int,
+    *,
+    pipe_axis: str = "pipe",
+    batch_axes: Sequence[str] = ("data", "fsdp"),
+) -> jax.Array:
+    """Run ``x`` through ``n_stages`` pipelined stages of ``stage_fn``.
+
+    Args:
+      stage_fn: ``(stage_param_slice, activation) -> activation`` — shape
+        preserving (homogeneous stages).
+      stage_params: pytree whose leaves are stacked on a leading
+        ``n_stages`` dim; sharded over ``pipe_axis`` (one stage per device).
+      x: global batch (batch, ...); split into ``n_micro`` microbatches on
+        the leading dim (must divide).
+      mesh: mesh containing ``pipe_axis`` (and optionally data axes the
+        batch dim is sharded over).
+
+    Returns activations of the final stage, same shape as ``x``.
+    """
+    batch = x.shape[0]
+    if batch % n_micro:
+        raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
+    x_stack = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(lambda _: P(pipe_axis), stage_params)
+    data = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    x_spec = P(None, data)  # microbatch dim replicated, batch dim sharded
+    fn = jax.shard_map(
+        functools.partial(_gpipe_local, stage_fn=stage_fn, axis_name=pipe_axis),
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+    )
+    out = fn(stage_params, x_stack)
+    return out.reshape(x.shape)
+
+
+def stack_stage_params(per_stage_params: Sequence[Any]) -> Any:
+    """Stack per-stage param pytrees into the leading-stage-dim layout."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params
+    )

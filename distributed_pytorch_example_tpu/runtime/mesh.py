@@ -14,6 +14,7 @@ Axis vocabulary used across the framework:
 - ``tensor``   — tensor (operator) parallelism inside layers.
 - ``sequence`` — sequence/context parallelism (ring attention).
 - ``expert``   — expert parallelism (MoE layers' expert dim).
+- ``pipe``     — pipeline parallelism (GPipe stages, parallel/pipeline.py).
 
 ``MeshSpec`` sizes multiply to the device count; -1 means "absorb the rest"
 (at most one axis).
@@ -37,6 +38,7 @@ class MeshSpec:
     tensor: int = 1
     sequence: int = 1
     expert: int = 1
+    pipe: int = 1
 
     def resolve(self, n_devices: int) -> "MeshSpec":
         sizes = dataclasses.asdict(self)
@@ -58,10 +60,10 @@ class MeshSpec:
 
     @property
     def axis_names(self) -> Sequence[str]:
-        return ("data", "fsdp", "tensor", "sequence", "expert")
+        return ("data", "fsdp", "tensor", "sequence", "expert", "pipe")
 
     def axis_sizes(self) -> Sequence[int]:
-        return (self.data, self.fsdp, self.tensor, self.sequence, self.expert)
+        return (self.data, self.fsdp, self.tensor, self.sequence, self.expert, self.pipe)
 
 
 def make_mesh(

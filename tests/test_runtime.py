@@ -74,13 +74,13 @@ def test_resolve_config_explicit_overrides():
 
 def test_mesh_default_all_data(devices):
     mesh = make_mesh()
-    assert dict(mesh.shape) == {"data": 8, "fsdp": 1, "tensor": 1, "sequence": 1, "expert": 1}
+    assert dict(mesh.shape) == {"data": 8, "fsdp": 1, "tensor": 1, "sequence": 1, "expert": 1, "pipe": 1}
     assert data_parallel_size(mesh) == 8
 
 
 def test_mesh_spec_resolution(devices):
     mesh = make_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
-    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "tensor": 2, "sequence": 1, "expert": 1}
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "tensor": 2, "sequence": 1, "expert": 1, "pipe": 1}
     assert data_axes(mesh) == ("data", "fsdp")
     assert data_parallel_size(mesh) == 4
 

@@ -121,8 +121,15 @@ def main():
             overrides["use_flash"] = args.flash == "on"
         if args.mesh_sequence not in (0, 1):
             overrides["seq_axis"] = "sequence"  # ring attention over the mesh
-    if args.moe_experts and args.model.startswith("gpt"):
+    if args.moe_experts:
+        if not args.model.startswith("gpt"):
+            parser.error(f"--moe-experts is only supported for gpt2 models, "
+                         f"not {args.model!r}")
         overrides["moe_experts"] = args.moe_experts
+    if args.mesh_expert not in (0, 1) and not args.moe_experts:
+        parser.error("--mesh-expert > 1 without --moe-experts would shrink "
+                     "data parallelism with nothing sharded on the expert "
+                     "axis; set --moe-experts too")
     model = dpx.models.get_model(args.model, **overrides)
     task = build_task(args, model)
 
