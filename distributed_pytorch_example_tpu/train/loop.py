@@ -73,6 +73,7 @@ class Trainer:
         self._profile_dir = profile_dir
         self._profile_window = profile_window
         self._profiler = None  # armed in fit()
+        self._saver = ckpt_lib.AsyncSaver()
         self._global_step = 0
 
     def _mesh_ctx(self):
@@ -159,6 +160,7 @@ class Trainer:
             if self._profile_dir
             else None
         )
+        self._saver.wait()  # a prior fit's pending write must land first
         resuming = bool(resume and os.path.exists(resume))
         writer = MetricsWriter(
             self._metrics_file,
@@ -187,10 +189,11 @@ class Trainer:
             )
         finally:
             # an exception mid-window must not leave a dangling active
-            # jax trace or an unflushed metrics file
+            # jax trace, an unflushed metrics file, or a half-queued save
             if self._profiler is not None:
                 self._profiler.close()
             writer.close()
+            self._saver.wait()
 
         total_time = time.time() - start_time
         if dist.is_coordinator():
@@ -260,6 +263,7 @@ class Trainer:
                         epoch + 1,
                         record["train_loss"],
                         extra,
+                        saver=self._saver,
                     )
                 ckpt_lib.save_checkpoint(
                     os.path.join(self.checkpoint_dir, ckpt_lib.LATEST_NAME),
@@ -267,6 +271,7 @@ class Trainer:
                     epoch + 1,
                     record["train_loss"],
                     extra,
+                    saver=self._saver,
                 )
             dist.barrier("epoch-end")
         return history, best_accuracy

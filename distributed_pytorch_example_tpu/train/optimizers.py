@@ -1,0 +1,91 @@
+"""Optimizer + LR-schedule factory.
+
+The reference trains with bare Adam at a fixed lr (reference train.py:249);
+that stays the default for parity. Beyond it, the factory composes the
+standard training-science stack from optax primitives:
+
+- optimizers: adam, adamw (decoupled weight decay), sgd (momentum), lamb;
+- schedules: constant, cosine decay with linear warmup, linear decay;
+- global-norm gradient clipping;
+- gradient accumulation (``every_k``): optax.MultiSteps wraps the update so
+  k micro-steps accumulate before one optimizer step — the large-batch
+  lever when HBM caps the per-step batch.
+
+Everything returns a single ``optax.GradientTransformation`` consumed
+unchanged by ``train.step`` — accumulation state lives inside the optimizer
+state pytree, so checkpointing and sharding rules apply to it for free.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+
+def make_schedule(
+    name: str,
+    lr: float,
+    *,
+    warmup_steps: int = 0,
+    total_steps: Optional[int] = None,
+    final_scale: float = 0.0,
+):
+    """An optax schedule: 'constant' | 'cosine' | 'linear'."""
+    name = name.lower()
+    if name == "constant":
+        if warmup_steps:
+            return optax.linear_schedule(0.0, lr, warmup_steps)
+        return lr
+    if total_steps is None:
+        raise ValueError(f"schedule {name!r} requires total_steps")
+    decay_steps = max(total_steps - warmup_steps, 1)
+    if name == "cosine":
+        sched = optax.cosine_decay_schedule(lr, decay_steps, alpha=final_scale)
+    elif name == "linear":
+        sched = optax.linear_schedule(lr, lr * final_scale, decay_steps)
+    else:
+        raise ValueError(f"Unknown schedule {name!r}")
+    if warmup_steps:
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, lr, warmup_steps), sched],
+            [warmup_steps],
+        )
+    return sched
+
+
+def make_optimizer(
+    name: str = "adam",
+    lr: float = 1e-3,
+    *,
+    schedule: str = "constant",
+    warmup_steps: int = 0,
+    total_steps: Optional[int] = None,
+    weight_decay: float = 0.0,
+    grad_clip_norm: Optional[float] = None,
+    momentum: float = 0.9,
+    every_k: int = 1,
+) -> optax.GradientTransformation:
+    """Compose clip → optimizer(schedule) → accumulation."""
+    lr_or_sched = make_schedule(
+        schedule, lr, warmup_steps=warmup_steps, total_steps=total_steps
+    )
+    name = name.lower()
+    if name == "adam":
+        opt = optax.adam(lr_or_sched)
+    elif name == "adamw":
+        opt = optax.adamw(lr_or_sched, weight_decay=weight_decay)
+    elif name == "sgd":
+        opt = optax.sgd(lr_or_sched, momentum=momentum)
+    elif name == "lamb":
+        opt = optax.lamb(lr_or_sched, weight_decay=weight_decay)
+    else:
+        raise ValueError(f"Unknown optimizer {name!r}")
+    parts = []
+    if grad_clip_norm:
+        parts.append(optax.clip_by_global_norm(grad_clip_norm))
+    parts.append(opt)
+    tx = optax.chain(*parts) if len(parts) > 1 else opt
+    if every_k > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=every_k)
+    return tx

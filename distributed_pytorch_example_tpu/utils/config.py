@@ -71,4 +71,15 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     parser.add_argument("--metrics-file", type=str, default=None,
                         help="JSONL epoch-metrics path (default: "
                         "<checkpoint-dir>/metrics.jsonl)")
+    parser.add_argument("--optimizer", type=str, default="adam",
+                        choices=("adam", "adamw", "sgd", "lamb"),
+                        help="reference default: adam (train.py:249)")
+    parser.add_argument("--schedule", type=str, default="constant",
+                        choices=("constant", "cosine", "linear"))
+    parser.add_argument("--warmup-steps", type=int, default=0)
+    parser.add_argument("--weight-decay", type=float, default=0.0)
+    parser.add_argument("--grad-clip", type=float, default=None,
+                        help="global-norm gradient clipping threshold")
+    parser.add_argument("--grad-accum", type=int, default=1,
+                        help="accumulate k micro-steps per optimizer step")
     return parser

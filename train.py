@@ -169,10 +169,24 @@ def main():
             raise ValueError
     except ValueError:
         parser.error("--profile-steps must be 'start,stop' with start < stop")
+    from distributed_pytorch_example_tpu.train.optimizers import make_optimizer
+
+    optimizer = make_optimizer(
+        args.optimizer,
+        args.lr,
+        schedule=args.schedule,
+        warmup_steps=args.warmup_steps,
+        # the schedule advances once per OPTIMIZER step; with accumulation
+        # that is every k-th micro-step
+        total_steps=max(1, args.epochs * len(train_loader) // args.grad_accum),
+        weight_decay=args.weight_decay,
+        grad_clip_norm=args.grad_clip,
+        every_k=args.grad_accum,
+    )
     trainer = dpx.train.Trainer(
         model,
         task,
-        optax.adam(args.lr),
+        optimizer,
         partitioner=partitioner,
         checkpoint_dir=args.checkpoint_dir,
         log_every=args.log_every,
