@@ -19,10 +19,12 @@ from __future__ import annotations
 
 import contextlib
 import os
+import sys
 import time
 from typing import Any, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from distributed_pytorch_example_tpu.parallel.api import Partitioner
@@ -130,9 +132,15 @@ class Trainer:
 
     def validate(self, loader) -> Dict[str, float]:
         acc = MetricAccumulator()
-        for batch in loader:
+        for batch_idx, batch in enumerate(loader):
             with self._mesh_ctx():
-                acc.append(self.eval_step(self.state, batch))
+                # device scalar index: one trace for all batches, distinct
+                # eval rng per batch (MLM masks must not repeat across val)
+                acc.append(
+                    self.eval_step(
+                        self.state, batch, jnp.asarray(batch_idx, jnp.int32)
+                    )
+                )
         return acc.result()
 
     # -- full fit ---------------------------------------------------------
@@ -193,7 +201,18 @@ class Trainer:
             if self._profiler is not None:
                 self._profiler.close()
             writer.close()
-            self._saver.wait()
+            if sys.exc_info()[1] is not None:
+                # already unwinding a training exception: a checkpoint-save
+                # failure must not replace it as the primary error
+                try:
+                    self._saver.wait()
+                except Exception:
+                    logger.exception(
+                        "async checkpoint save failed while handling a "
+                        "training exception (training error follows)"
+                    )
+            else:
+                self._saver.wait()
 
         total_time = time.time() - start_time
         if dist.is_coordinator():

@@ -2,38 +2,48 @@
 
 The reference pays a host sync every step (``loss.item()``, train.py:141 —
 flagged in SURVEY.md §3.2 as a cost the TPU design must not replicate).
-Here per-step metrics stay on device; the accumulator holds device scalars
-and only materializes floats at a log boundary or epoch end, letting steps
-dispatch ahead of the host.
+Here per-step metrics stay on device as a running sum; the accumulator only
+materializes floats at a log boundary or epoch end, letting steps dispatch
+ahead of the host. Memory is O(1) in the number of steps — one device
+scalar per metric key, regardless of epoch length.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, Optional
 
 import jax
-import numpy as np
 
 
 class MetricAccumulator:
-    """Equal-weight running mean of device-scalar metric dicts."""
+    """Equal-weight running mean of device-scalar metric dicts.
+
+    ``append`` adds each batch's scalars into a device-side running sum (a
+    handful of async scalar adds — no host sync, no per-step retention);
+    ``result`` performs the single host fetch and divides by the count.
+    """
 
     def __init__(self):
-        self._batches: List[Dict[str, jax.Array]] = []
+        self._sums: Optional[Dict[str, jax.Array]] = None
+        self._count = 0
 
     def append(self, metrics: Dict[str, jax.Array]) -> None:
-        self._batches.append(metrics)
+        if self._sums is None:
+            self._sums = dict(metrics)
+        else:
+            self._sums = {k: v + metrics[k] for k, v in self._sums.items()}
+        self._count += 1
 
     def __len__(self) -> int:
-        return len(self._batches)
+        return self._count
 
     def result(self) -> Dict[str, float]:
-        """Fetch and average everything accumulated (one host sync)."""
-        if not self._batches:
+        """Fetch the running sums and average (one host sync)."""
+        if not self._count:
             return {}
-        fetched = jax.device_get(self._batches)
-        keys = fetched[0].keys()
-        return {k: float(np.mean([b[k] for b in fetched])) for k in keys}
+        fetched = jax.device_get(self._sums)
+        return {k: float(v) / self._count for k, v in fetched.items()}
 
     def reset(self) -> None:
-        self._batches.clear()
+        self._sums = None
+        self._count = 0

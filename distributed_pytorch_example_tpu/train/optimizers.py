@@ -22,6 +22,10 @@ from typing import Optional
 
 import optax
 
+from distributed_pytorch_example_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
 
 def make_schedule(
     name: str,
@@ -81,6 +85,19 @@ def make_optimizer(
         opt = optax.lamb(lr_or_sched, weight_decay=weight_decay)
     else:
         raise ValueError(f"Unknown optimizer {name!r}")
+    # flags are independent of the optimizer choice, so a silently-dropped
+    # setting is a footgun: say so instead of training a different model
+    if weight_decay and name in ("adam", "sgd"):
+        logger.warning(
+            "weight_decay=%s is ignored by optimizer %r — use 'adamw' or "
+            "'lamb' for decoupled weight decay",
+            weight_decay, name,
+        )
+    if momentum != 0.9 and name != "sgd":
+        logger.warning(
+            "momentum=%s only applies to optimizer 'sgd' (got %r)",
+            momentum, name,
+        )
     parts = []
     if grad_clip_norm:
         parts.append(optax.clip_by_global_norm(grad_clip_norm))

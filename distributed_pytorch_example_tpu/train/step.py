@@ -90,15 +90,18 @@ def build_train_step(model, task, optimizer: optax.GradientTransformation):
 
 
 def build_eval_step(model, task):
-    """One compiled eval step: (state, batch) -> metrics (no grad, no dropout).
+    """One compiled eval step: (state, batch, batch_idx) -> metrics.
 
     Reference parity: ``validate`` under ``model.eval()`` + ``no_grad``
-    (train.py:154-175).
+    (train.py:154-175). ``batch_idx`` is folded into the eval rng so tasks
+    that draw randomness at eval time (e.g. MLM masking) see a different
+    draw per validation batch instead of one repeated pattern.
     """
 
-    def eval_step(state: TrainState, batch):
+    def eval_step(state: TrainState, batch, batch_idx=0):
+        rng = jax.random.fold_in(state.rng, batch_idx)
         _, metrics, _ = task.compute_loss(
-            model, state.params, state.model_state, batch, state.rng, train=False
+            model, state.params, state.model_state, batch, rng, train=False
         )
         return metrics
 
