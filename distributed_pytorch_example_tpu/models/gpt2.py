@@ -35,9 +35,18 @@ class GPT2(nn.Module):
     moe_experts: int = 0  # >0: MoE MLP on every moe_every-th block
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
+    pipe_axis: Optional[str] = None  # mesh axis for pipeline stages (PP)
+    pipe_microbatches: int = 0  # 0 = auto
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
+        if self.pipe_axis is not None and (self.seq_axis or self.moe_experts):
+            raise ValueError(
+                "pipe_axis cannot combine with seq_axis or moe_experts yet "
+                "(the pipeline stages are homogeneous dense blocks)"
+            )
+        if self.pipe_axis is not None and self.dropout_rate:
+            raise ValueError("pipelined GPT-2 requires dropout_rate=0")
         # tokens: (B, S) int32 → logits (B, S, vocab)
         embed = nn.Embed(
             self.vocab_size,
@@ -54,25 +63,46 @@ class GPT2(nn.Module):
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
 
-        x = TransformerStack(
-            num_layers=self.num_layers,
-            num_heads=self.num_heads,
-            head_dim=self.model_dim // self.num_heads,
-            model_dim=self.model_dim,
-            mlp_dim=self.mlp_dim,
-            causal=True,
-            prenorm=True,
-            dropout_rate=self.dropout_rate,
-            layer_norm_epsilon=1e-5,
-            dtype=self.dtype,
-            use_flash=self.use_flash,
-            seq_axis=self.seq_axis,
-            remat=self.remat,
-            moe_experts=self.moe_experts,
-            moe_every=self.moe_every,
-            moe_capacity_factor=self.moe_capacity_factor,
-            name="decoder",
-        )(x, train=train)
+        if self.pipe_axis is not None:
+            from distributed_pytorch_example_tpu.models.stacked import (
+                StackedDecoder,
+            )
+
+            x = StackedDecoder(
+                num_layers=self.num_layers,
+                num_heads=self.num_heads,
+                head_dim=self.model_dim // self.num_heads,
+                model_dim=self.model_dim,
+                mlp_dim=self.mlp_dim,
+                causal=True,
+                layer_norm_epsilon=1e-5,
+                dtype=self.dtype,
+                use_flash=self.use_flash,
+                remat=self.remat,
+                pipe_axis=self.pipe_axis,
+                pipe_microbatches=self.pipe_microbatches,
+                name="decoder",
+            )(x, train=train)
+        else:
+            x = TransformerStack(
+                num_layers=self.num_layers,
+                num_heads=self.num_heads,
+                head_dim=self.model_dim // self.num_heads,
+                model_dim=self.model_dim,
+                mlp_dim=self.mlp_dim,
+                causal=True,
+                prenorm=True,
+                dropout_rate=self.dropout_rate,
+                layer_norm_epsilon=1e-5,
+                dtype=self.dtype,
+                use_flash=self.use_flash,
+                seq_axis=self.seq_axis,
+                remat=self.remat,
+                moe_experts=self.moe_experts,
+                moe_every=self.moe_every,
+                moe_capacity_factor=self.moe_capacity_factor,
+                name="decoder",
+            )(x, train=train)
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="final_ln")(x)
         from distributed_pytorch_example_tpu.models.transformer import (
             tied_head_logits,

@@ -1,0 +1,207 @@
+"""Stacked-parameter transformer decoder: scan-over-layers + pipelining.
+
+The per-layer-module ``TransformerStack`` (models/transformer.py) creates
+one param subtree per block — ideal for path-regex tensor-parallel rules,
+useless for pipeline parallelism, which needs every weight stacked on a
+leading ``num_layers`` dim so equal slices can live on consecutive devices
+of the ``pipe`` mesh axis (parallel/pipeline.py).
+
+``StackedDecoder`` owns explicit stacked params (leaf shapes lead with
+``num_layers``) and runs them one of two ways:
+
+- **sequential** (no pipe axis, or pipe size 1): ``lax.scan`` over the
+  layer dim — also the compile-time-friendly formulation for deep stacks;
+- **pipelined**: params reshaped to (n_stages, layers_per_stage, ...) and
+  driven by the GPipe schedule in ``parallel.pipeline.gpipe``; each stage
+  scans its own layer slice. Tensor parallelism still applies *inside*
+  the pipeline (the gpipe shard_map is manual over ``pipe`` only, so the
+  Megatron shardings from parallel/partition.py stay automatic).
+
+Beyond-reference capability: the reference is DP-only (its model is a
+3-layer MLP, reference train.py:32-50); this exists for the BASELINE.json
+transformer workloads at pipeline scale.
+
+Block semantics match the pre-LN ``TransformerBlock``: LN → qkv → attention
+(via ops.attention.dot_product_attention, so flash dispatch is shared) →
+residual; LN → MLP(gelu) → residual. No dropout (pipeline training runs
+at dropout 0; GPT-2's default here is 0.0).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_pytorch_example_tpu.ops.attention import dot_product_attention
+
+
+def _layer_norm(x, scale, bias, eps, dtype):
+    """LayerNorm with float32 statistics, output in compute dtype."""
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    y = (x32 - mu) * lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
+
+
+class StackedDecoder(nn.Module):
+    """Homogeneous pre-LN transformer blocks with layer-stacked params."""
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    model_dim: int
+    mlp_dim: int
+    causal: bool = True
+    layer_norm_epsilon: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None
+    remat: bool = False
+    pipe_axis: Optional[str] = None  # mesh axis for pipeline stages
+    pipe_microbatches: int = 0  # 0 = auto (largest k*pipe <= 4*pipe | batch)
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        L, D, M = self.num_layers, self.model_dim, self.mlp_dim
+        F = self.num_heads * self.head_dim
+        lecun = nn.initializers.lecun_normal()
+        zeros, ones = nn.initializers.zeros, nn.initializers.ones
+
+        def stacked(name, init, shape):
+            return self.param(name, init, (L, *shape))
+
+        params = {
+            "ln1_scale": stacked("ln1_scale", ones, (D,)),
+            "ln1_bias": stacked("ln1_bias", zeros, (D,)),
+            "q_kernel": stacked("q_kernel", lecun, (D, F)),
+            "q_bias": stacked("q_bias", zeros, (F,)),
+            "k_kernel": stacked("k_kernel", lecun, (D, F)),
+            "k_bias": stacked("k_bias", zeros, (F,)),
+            "v_kernel": stacked("v_kernel", lecun, (D, F)),
+            "v_bias": stacked("v_bias", zeros, (F,)),
+            "o_kernel": stacked("o_kernel", lecun, (F, D)),
+            "o_bias": stacked("o_bias", zeros, (D,)),
+            "ln2_scale": stacked("ln2_scale", ones, (D,)),
+            "ln2_bias": stacked("ln2_bias", zeros, (D,)),
+            "up_kernel": stacked("up_kernel", lecun, (D, M)),
+            "up_bias": stacked("up_bias", zeros, (M,)),
+            "down_kernel": stacked("down_kernel", lecun, (M, D)),
+            "down_bias": stacked("down_bias", zeros, (D,)),
+        }
+
+        x = x.astype(self.dtype)
+        block = self._block_fn(x.shape)
+        if self.remat:
+            block = jax.checkpoint(block, prevent_cse=False)
+
+        pipe = self._pipe_size()
+        if pipe <= 1:
+            def body(h, lp):
+                return block(lp, h), None
+
+            out, _ = lax.scan(body, x, params)
+            return out
+        return self._pipelined(block, params, x, pipe)
+
+    # -- execution paths ----------------------------------------------------
+
+    def _pipe_size(self) -> int:
+        """Pipeline span of the active mesh (0/1 = run sequentially)."""
+        if self.pipe_axis is None:
+            return 1
+        from distributed_pytorch_example_tpu.runtime.mesh import current_mesh
+
+        mesh = current_mesh()
+        if mesh is None:
+            raise RuntimeError(
+                f"pipe_axis={self.pipe_axis!r} requires an active `with "
+                "mesh:` context (Trainer enters it automatically; wrap "
+                "manual apply() calls yourself)."
+            )
+        return mesh.shape.get(self.pipe_axis, 1)
+
+    def _pipelined(self, block, params, x, n_stages):
+        from distributed_pytorch_example_tpu.parallel.pipeline import gpipe
+        from distributed_pytorch_example_tpu.runtime.mesh import current_mesh
+
+        mesh = current_mesh()
+        L = self.num_layers
+        if L % n_stages:
+            raise ValueError(
+                f"num_layers {L} not divisible by pipe size {n_stages}"
+            )
+        from distributed_pytorch_example_tpu.runtime.mesh import (
+            data_parallel_size,
+        )
+
+        n_micro = self.pipe_microbatches or _auto_microbatches(
+            x.shape[0], n_stages, data_parallel_size(mesh)
+        )
+        sp = jax.tree_util.tree_map(
+            lambda v: v.reshape(n_stages, L // n_stages, *v.shape[1:]),
+            params,
+        )
+
+        def stage_fn(stage_params, h):
+            def body(hh, lp):
+                return block(lp, hh), None
+
+            out, _ = lax.scan(body, h, stage_params)
+            return out
+
+        return gpipe(
+            stage_fn, sp, x, mesh, n_micro, pipe_axis=self.pipe_axis
+        )
+
+    def _block_fn(self, x_shape):
+        """(layer_params, h) -> h, pre-LN block in compute dtype."""
+        seq = x_shape[1]
+        dtype = self.dtype
+        eps = self.layer_norm_epsilon
+        heads_shape = (-1, seq, self.num_heads, self.head_dim)
+        scale = 1.0 / math.sqrt(self.head_dim)
+
+        def dense(z, kernel, bias):
+            return z @ kernel.astype(dtype) + bias.astype(dtype)
+
+        def block(lp, h):
+            a = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"], eps, dtype)
+            q = dense(a, lp["q_kernel"], lp["q_bias"]).reshape(heads_shape)
+            k = dense(a, lp["k_kernel"], lp["k_bias"]).reshape(heads_shape)
+            v = dense(a, lp["v_kernel"], lp["v_bias"]).reshape(heads_shape)
+            attn = dot_product_attention(
+                q, k, v, causal=self.causal, softmax_scale=scale,
+                use_flash=self.use_flash,
+            )
+            attn = attn.reshape(*h.shape[:-1], -1)
+            h = h + dense(attn, lp["o_kernel"], lp["o_bias"])
+            b = _layer_norm(h, lp["ln2_scale"], lp["ln2_bias"], eps, dtype)
+            mlp = dense(nn.gelu(dense(b, lp["up_kernel"], lp["up_bias"])),
+                        lp["down_kernel"], lp["down_bias"])
+            return h + mlp
+
+        return block
+
+
+def _auto_microbatches(batch: int, n_stages: int, dp_size: int = 1) -> int:
+    """Largest k*n_stages <= 4*n_stages that divides the batch, keeping
+    each microbatch divisible by the data-parallel size (the microbatch
+    batch dim stays sharded over data/fsdp inside the pipeline)."""
+    for k in (4, 3, 2, 1):
+        n_micro = k * n_stages
+        if (
+            n_micro <= batch
+            and batch % n_micro == 0
+            and (batch // n_micro) % dp_size == 0
+        ):
+            return n_micro
+    raise ValueError(
+        f"batch {batch} has no valid microbatch split for pipe size "
+        f"{n_stages} with data-parallel size {dp_size}; pass "
+        f"pipe_microbatches explicitly"
+    )

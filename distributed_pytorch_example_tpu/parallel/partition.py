@@ -44,6 +44,15 @@ TRANSFORMER_TP_RULES: tuple = (
     # stays replicated (tiny, and every token needs it)
     (r"moe/(up|down)_kernel$", P("expert", None, None)),
     (r"moe/(up|down)_bias$", P("expert", None)),
+    # layer-stacked decoder (models/stacked.py): leading num_layers dim on
+    # 'pipe' (pipeline stages), features on 'tensor' per the same Megatron
+    # column/row split. Ordered after the moe rules: `up_kernel$` would
+    # otherwise shadow `moe/up_kernel`.
+    (r"(q|k|v|up)_kernel$", P("pipe", None, "tensor")),
+    (r"(q|k|v|up)_bias$", P("pipe", "tensor")),
+    (r"(o|down)_kernel$", P("pipe", "tensor", None)),
+    (r"(o|down)_bias$", P("pipe", None)),
+    (r"ln[12]_(scale|bias)$", P("pipe", None)),
 )
 
 

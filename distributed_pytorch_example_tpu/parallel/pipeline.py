@@ -10,12 +10,30 @@ via ``lax.ppermute`` (a neighbor ICI transfer). The whole schedule is a
 differentiable — the backward pass replays the pipeline in reverse with the
 transposed permutes, no hand-written adjoint needed.
 
+Memory design (what makes activation memory actually drop with stage
+count): the microbatch stack is **sharded over the pipe axis**, never
+replicated —
+
+- *input queue*: each stage holds ``m = n_micro / n_stages`` input
+  microbatches; the queue rotates one slot toward stage 0 per tick, so
+  stage 0 always finds microbatch ``t`` at its queue head at tick ``t``;
+- *output delivery ring*: the last stage emits each finished microbatch
+  into a one-register-per-device ring that shifts one stage per tick;
+  every stage stores the microbatches whose final resting place it is
+  (microbatch ``u`` lands on stage ``u // m``), so the outputs come back
+  sharded over ``pipe`` exactly like the inputs. No full-batch ``psum``.
+
+The shard_map is *manual over the pipe axis only* (``axis_names={pipe}``):
+data/fsdp batch sharding and Megatron tensor parallelism inside the stage
+function stay automatic (GSPMD inserts their collectives as usual), so
+PP composes with DP / TP / FSDP.
+
 SPMD realities: every device computes at every tick (inactive ticks produce
-garbage that is never consumed — the activity predicate guarantees a
-receiver only uses data its upstream produced while active), so utilization
-is the usual GPipe ``n_micro / (n_micro + n_stages - 1)``; choose
-``n_micro >> n_stages``. Stage params must be a stacked pytree with leading
-dim ``n_stages``, and the stage function must preserve activation shape.
+garbage that is never consumed — the store predicates guarantee only
+microbatches a stage produced while active are kept), so utilization is the
+usual GPipe ``n_micro / n_ticks``; choose ``n_micro >> n_stages``. Stage
+params must be a stacked pytree with leading dim ``n_stages``, and the
+stage function must preserve activation shape.
 """
 
 from __future__ import annotations
@@ -26,58 +44,107 @@ from typing import Any, Callable, Sequence
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_pytorch_example_tpu.parallel.api import pvary_like
 
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
 
-def _gpipe_local(stage_params, x_stack, *, stage_fn: StageFn, axis_name: str):
-    """Per-device pipeline program; call under shard_map.
+def _store(buf, y, slot, cond):
+    """buf[slot] = y where cond (traced slot index, predicate scalar)."""
+    updated = lax.dynamic_update_index_in_dim(
+        buf, y.astype(buf.dtype), jnp.clip(slot, 0, buf.shape[0] - 1), 0
+    )
+    return jnp.where(cond, updated, buf)
+
+
+def _gpipe_local(stage_params, in_buf, *, stage_fn: StageFn, axis_name: str,
+                 n_micro: int):
+    """Per-device pipeline program; call under shard_map (manual on pipe).
 
     stage_params: local slice (1, ...) of the stage-stacked params.
-    x_stack: (n_micro, microbatch, ...) — full microbatch stack (the
-    scheduler picks which one this stage consumes at each tick).
+    in_buf: (m, microbatch, ...) — this stage's shard of the microbatch
+    queue (stage d initially holds microbatches [d*m, (d+1)*m)).
     """
     n_stages = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
-    n_micro = x_stack.shape[0]
+    m = in_buf.shape[0]
     params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
-    shift = [(i, i + 1) for i in range(n_stages - 1)]
-    n_ticks = n_micro + n_stages - 1
+
+    shift_up = [(i, i + 1) for i in range(n_stages - 1)]  # activations
+    ring_down = [(i, (i - 1) % n_stages) for i in range(n_stages)]  # inputs
+    ring_up = [(i, (i + 1) % n_stages) for i in range(n_stages)]  # delivery
+
+    # ticks: last stage emits microbatch u at tick u + n_stages - 1; a ring
+    # delivery to stage d takes d more ticks (stage n_stages-1 self-stores
+    # its own block at emission). The last ring-delivered block is block
+    # n_stages-2, finished at (n_stages-1)*m - 1 + (n_stages-1) + (n_stages-2).
+    n_ticks = max(
+        n_micro + n_stages - 1,
+        (n_stages - 1) * m + 2 * n_stages - 3,
+    )
 
     def tick(carry, t):
-        incoming, outputs = carry
-        # stage 0 feeds from the input stack; later stages from upstream
-        mb_t = lax.dynamic_index_in_dim(
-            x_stack, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
-        )
-        x_in = jnp.where(stage == 0, mb_t, incoming)
+        incoming, in_buf, out_buf, reg_y, reg_u = carry
+
+        # stage 0 feeds from its queue head; later stages from upstream.
+        # The queue is circular (head slot = t % m): the head is ppermuted
+        # toward stage 0 and the received slot written back in place —
+        # one microbatch of traffic per tick, not a full-queue copy.
+        head_slot = t % m
+        head = lax.dynamic_index_in_dim(in_buf, head_slot, 0, keepdims=False)
+        x_in = jnp.where(stage == 0, head, incoming)
         y = stage_fn(params, x_in)
-        active = (t - stage >= 0) & (t - stage < n_micro)
-        # the final stage records its (active) results
-        store = jnp.clip(t - stage, 0, n_micro - 1)
-        updated = lax.dynamic_update_index_in_dim(outputs, y, store, 0)
-        outputs = jnp.where(
-            active & (stage == n_stages - 1), updated, outputs
+
+        u_emit = t - (n_stages - 1)  # microbatch the last stage finishes now
+        emitting = (u_emit >= 0) & (u_emit < n_micro)
+        is_last = stage == n_stages - 1
+        # the last stage's own block ([n_micro-m, n_micro)) never rides the
+        # ring: store it directly at emission
+        out_buf = _store(
+            out_buf, y, u_emit % m,
+            is_last & emitting & (u_emit // m == stage),
         )
+
+        # delivery ring: the last stage replaces the register with its fresh
+        # output (nothing routes *through* the last stage — ring targets are
+        # stages 0..n_stages-2, reached going up from the wrap to stage 0);
+        # other stages relay what they hold
+        send_y = jnp.where(is_last, y, reg_y)
+        send_u = jnp.where(is_last, jnp.where(emitting, u_emit, -1), reg_u)
+        reg_y = lax.ppermute(send_y, axis_name, ring_up)
+        reg_u = lax.ppermute(send_u, axis_name, ring_up)
+        out_buf = _store(
+            out_buf, reg_y, reg_u % m,
+            (reg_u >= 0) & (reg_u // m == stage) & ~is_last,
+        )
+
+        # inter-stage activation handoff
         if n_stages > 1:
-            incoming = lax.ppermute(y, axis_name, shift)
-        return (incoming, outputs), None
+            incoming = lax.ppermute(y, axis_name, shift_up)
+        # input queue rotation: the consumed head slot refills from the
+        # upstream device, so stage 0's next head holds microbatch t+1
+        received = lax.ppermute(head, axis_name, ring_down)
+        in_buf = lax.dynamic_update_index_in_dim(
+            in_buf, received, head_slot, 0
+        )
+        return (incoming, in_buf, out_buf, reg_y, reg_u), None
 
     # carries become pipe-varying through the stage params / ppermute, so
-    # the init must carry that vma too (x_stack itself is pipe-replicated)
-    incoming0 = pvary_like(
-        jnp.zeros(x_stack.shape[1:], x_stack.dtype), x_stack, (axis_name,)
+    # constant inits must carry that vma too
+    def pv(x):
+        return pvary_like(x, in_buf, (axis_name,))
+
+    incoming0 = pv(jnp.zeros(in_buf.shape[1:], in_buf.dtype))
+    outputs0 = pv(jnp.zeros_like(in_buf))
+    reg_y0 = pv(jnp.zeros(in_buf.shape[1:], in_buf.dtype))
+    reg_u0 = pv(jnp.full((), -1, jnp.int32))
+    (_, _, out_buf, _, _), _ = lax.scan(
+        tick, (incoming0, in_buf, outputs0, reg_y0, reg_u0),
+        jnp.arange(n_ticks),
     )
-    outputs0 = pvary_like(jnp.zeros_like(x_stack), x_stack, (axis_name,))
-    (_, outputs), _ = lax.scan(
-        tick, (incoming0, outputs0), jnp.arange(n_ticks)
-    )
-    # only the last stage holds real outputs; reduce to make them uniform
-    outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
-    return lax.psum(outputs, axis_name)
+    return out_buf
 
 
 def gpipe(
@@ -97,26 +164,44 @@ def gpipe(
         preserving (homogeneous stages).
       stage_params: pytree whose leaves are stacked on a leading
         ``n_stages`` dim; sharded over ``pipe_axis`` (one stage per device).
+        Shardings over other mesh axes (e.g. ``tensor``) stay automatic.
       x: global batch (batch, ...); split into ``n_micro`` microbatches on
-        the leading dim (must divide).
+        the leading dim (``n_micro`` must divide the batch and be a
+        multiple of the pipe-axis size).
       mesh: mesh containing ``pipe_axis`` (and optionally data axes the
         batch dim is sharded over).
 
     Returns activations of the final stage, same shape as ``x``.
     """
     batch = x.shape[0]
+    n_stages = mesh.shape[pipe_axis]
     if batch % n_micro:
         raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
+    if n_micro % n_stages:
+        raise ValueError(
+            f"n_micro {n_micro} not divisible by pipe size {n_stages}"
+        )
     x_stack = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
+    # the microbatch queue lives sharded over the pipe axis (dim 0); the
+    # per-microbatch batch dim keeps the usual data sharding (dim 1)
+    data = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    x_stack = lax.with_sharding_constraint(
+        x_stack,
+        NamedSharding(mesh, P(pipe_axis, data or None)),
+    )
 
-    param_specs = jax.tree_util.tree_map(lambda _: P(pipe_axis), stage_params)
-    data = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
-    x_spec = P(None, data)  # microbatch dim replicated, batch dim sharded
     fn = jax.shard_map(
-        functools.partial(_gpipe_local, stage_fn=stage_fn, axis_name=pipe_axis),
+        functools.partial(
+            _gpipe_local, stage_fn=stage_fn, axis_name=pipe_axis,
+            n_micro=n_micro,
+        ),
         mesh=mesh,
-        in_specs=(param_specs, x_spec),
-        out_specs=x_spec,
+        in_specs=(
+            jax.tree_util.tree_map(lambda _: P(pipe_axis), stage_params),
+            P(pipe_axis),
+        ),
+        out_specs=P(pipe_axis),
+        axis_names={pipe_axis},
     )
     out = fn(stage_params, x_stack)
     return out.reshape(x.shape)

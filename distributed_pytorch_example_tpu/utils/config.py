@@ -48,6 +48,12 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     parser.add_argument("--mesh-tensor", type=int, default=1)
     parser.add_argument("--mesh-sequence", type=int, default=1)
     parser.add_argument("--mesh-expert", type=int, default=1)
+    parser.add_argument("--mesh-pipe", type=int, default=1,
+                        help=">1: GPipe pipeline stages over the 'pipe' mesh "
+                        "axis (gpt2; layers split across stages)")
+    parser.add_argument("--pipe-microbatches", type=int, default=0,
+                        help="microbatches per pipelined step (0 = auto; "
+                        "must divide batch and be a multiple of --mesh-pipe)")
     parser.add_argument("--moe-experts", type=int, default=0,
                         help=">0: MoE MLP with this many experts on every "
                         "other transformer block (gpt2)")

@@ -62,7 +62,7 @@ def test_full_pipe_axis(devices):
     block, per_stage, stacked, stage_fn = make_stages(8)
     x = jnp.asarray(np.random.default_rng(1).standard_normal((8, 16)), jnp.float32)
     expected = sequential_reference(block, per_stage, x)
-    got = gpipe(stage_fn, stacked, x, mesh, n_micro=4)
+    got = gpipe(stage_fn, stacked, x, mesh, n_micro=8)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-5)
 
 
@@ -124,3 +124,21 @@ def test_batch_not_divisible_raises(devices):
     x = jnp.zeros((10, 16))
     with pytest.raises(ValueError, match="divisible"):
         gpipe(stage_fn, stacked, x, mesh, n_micro=4)
+
+
+def test_n_micro_not_multiple_of_stages_raises(devices):
+    mesh = make_mesh(MeshSpec(data=2, pipe=4))
+    _, _, stacked, stage_fn = make_stages(4)
+    x = jnp.zeros((12, 16))
+    with pytest.raises(ValueError, match="pipe size"):
+        gpipe(stage_fn, stacked, x, mesh, n_micro=6)
+
+
+def test_single_stage_pipe(devices):
+    """pipe=1 degenerates to sequential microbatching, still exact."""
+    mesh = make_mesh(MeshSpec(data=-1, pipe=1))
+    block, per_stage, stacked, stage_fn = make_stages(1)
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((32, 16)), jnp.float32)
+    expected = sequential_reference(block, per_stage, x)
+    got = gpipe(stage_fn, stacked, x, mesh, n_micro=4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-5)

@@ -93,6 +93,7 @@ def main():
             tensor=args.mesh_tensor,
             sequence=args.mesh_sequence,
             expert=args.mesh_expert,
+            pipe=args.mesh_pipe,
         )
     )
     dp_size = dpx.runtime.mesh.data_parallel_size(mesh)
@@ -130,17 +131,30 @@ def main():
         parser.error("--mesh-expert > 1 without --moe-experts would shrink "
                      "data parallelism with nothing sharded on the expert "
                      "axis; set --moe-experts too")
+    if args.mesh_pipe not in (0, 1):
+        if not args.model.startswith("gpt"):
+            parser.error(f"--mesh-pipe is only supported for gpt2 models, "
+                         f"not {args.model!r}")
+        overrides["pipe_axis"] = "pipe"
+        overrides["pipe_microbatches"] = args.pipe_microbatches
     model = dpx.models.get_model(args.model, **overrides)
     task = build_task(args, model)
 
-    if args.partition == "fsdp":
+    pipelined = args.mesh_pipe not in (0, 1)
+    if args.partition == "fsdp" and not pipelined:
         partitioner = dpx.parallel.fsdp(mesh)
-    elif args.partition == "tp":
+    elif args.partition == "tp" or pipelined:
+        # pipelined runs need the stacked-param rules (stage stacks sharded
+        # on 'pipe') regardless of --partition; with fsdp the unmatched
+        # leaves (embeddings, norms) shard on the fsdp axis, otherwise they
+        # stay replicated (DP semantics)
         from distributed_pytorch_example_tpu.parallel.partition import (
             transformer_partitioner,
         )
 
-        partitioner = transformer_partitioner(mesh)
+        partitioner = transformer_partitioner(
+            mesh, fsdp_rest=args.partition == "fsdp"
+        )
     else:
         partitioner = dpx.parallel.data_parallel(mesh)
 
