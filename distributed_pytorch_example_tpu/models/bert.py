@@ -30,6 +30,10 @@ class BertBase(nn.Module):
     use_flash: Optional[bool] = None
     seq_axis: Optional[str] = None  # mesh axis for ring attention (SP)
     remat: bool = False
+    # real (padded) corpora: keys at pad positions are masked out of every
+    # attention — flash keeps its fast path (kv_mask streams through the
+    # kernel). None = no padding mask (synthetic data has no pad tokens).
+    pad_token_id: Optional[int] = None
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
@@ -51,6 +55,14 @@ class BertBase(nn.Module):
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
 
+        kv_mask = None
+        if self.pad_token_id is not None:
+            if self.seq_axis is not None:
+                raise ValueError(
+                    "pad_token_id cannot combine with seq_axis: the "
+                    "ring-attention path has no padding-mask support yet"
+                )
+            kv_mask = tokens != self.pad_token_id
         x = TransformerStack(
             num_layers=self.num_layers,
             num_heads=self.num_heads,
@@ -66,7 +78,7 @@ class BertBase(nn.Module):
             seq_axis=self.seq_axis,
             remat=self.remat,
             name="encoder",
-        )(x, train=train)
+        )(x, kv_mask=kv_mask, train=train)
 
         # MLM head: transform, then decode against the tied embedding matrix.
         x = nn.Dense(self.model_dim, dtype=self.dtype, name="mlm_dense")(x)

@@ -62,7 +62,7 @@ class MultiHeadAttention(nn.Module):
     seq_axis: Optional[str] = None  # mesh axis for ring attention
 
     @nn.compact
-    def __call__(self, x, mask=None, *, train: bool = False):
+    def __call__(self, x, mask=None, *, kv_mask=None, train: bool = False):
         features = self.num_heads * self.head_dim
         q = nn.Dense(features, dtype=self.dtype, name="q")(x)
         k = nn.Dense(features, dtype=self.dtype, name="k")(x)
@@ -70,18 +70,20 @@ class MultiHeadAttention(nn.Module):
         batch, seq = x.shape[0], x.shape[1]
         shape = (batch, seq, self.num_heads, self.head_dim)
         q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
-        ring_mesh = self._ring_mesh(mask)
+        ring_mesh = self._ring_mesh(mask if mask is not None else kv_mask)
         if ring_mesh is not None:
             from distributed_pytorch_example_tpu.ops.ring_attention import (
                 ring_attention_sharded,
             )
 
             out = ring_attention_sharded(
-                q, k, v, ring_mesh, seq_axis=self.seq_axis, causal=self.causal
+                q, k, v, ring_mesh, seq_axis=self.seq_axis,
+                causal=self.causal, use_flash=self.use_flash,
             )
         else:
             out = dot_product_attention(
-                q, k, v, mask=mask, causal=self.causal, use_flash=self.use_flash
+                q, k, v, mask=mask, kv_mask=kv_mask, causal=self.causal,
+                use_flash=self.use_flash,
             )
         out = out.reshape((batch, seq, features))
         out = nn.Dense(self.model_dim, dtype=self.dtype, name="o")(out)
@@ -98,11 +100,6 @@ class MultiHeadAttention(nn.Module):
         """
         if self.seq_axis is None:
             return None
-        if self.use_flash:
-            raise ValueError(
-                "seq_axis and use_flash=True conflict: the ring path has no "
-                "flash kernel yet. Set use_flash=None (auto) or False."
-            )
         if mask is not None:
             raise NotImplementedError(
                 "custom masks are not supported on the ring-attention path"
@@ -158,7 +155,7 @@ class TransformerBlock(nn.Module):
     moe_capacity_factor: float = 1.25
 
     @nn.compact
-    def __call__(self, x, mask=None, *, train: bool = False):
+    def __call__(self, x, mask=None, *, kv_mask=None, train: bool = False):
         attn = MultiHeadAttention(
             num_heads=self.num_heads,
             head_dim=self.head_dim,
@@ -191,10 +188,10 @@ class TransformerBlock(nn.Module):
         ln1 = nn.LayerNorm(epsilon=self.layer_norm_epsilon, dtype=self.dtype, name="ln1")
         ln2 = nn.LayerNorm(epsilon=self.layer_norm_epsilon, dtype=self.dtype, name="ln2")
         if self.prenorm:
-            x = x + attn(ln1(x), mask, train=train)
+            x = x + attn(ln1(x), mask, kv_mask=kv_mask, train=train)
             x = x + mlp(ln2(x), train=train)
         else:  # post-LN (original BERT)
-            x = ln1(x + attn(x, mask, train=train))
+            x = ln1(x + attn(x, mask, kv_mask=kv_mask, train=train))
             x = ln2(x + mlp(x, train=train))
         return x
 
@@ -226,7 +223,7 @@ class TransformerStack(nn.Module):
     moe_capacity_factor: float = 1.25
 
     @nn.compact
-    def __call__(self, x, mask=None, *, train: bool = False):
+    def __call__(self, x, mask=None, *, kv_mask=None, train: bool = False):
         if self.moe_experts > 0 and self.moe_every < 1:
             raise ValueError(
                 f"moe_every must be >= 1 when moe_experts > 0, got "
@@ -252,10 +249,12 @@ class TransformerStack(nn.Module):
             )
             if self.remat:
                 apply = nn.remat(
-                    lambda mdl, h, m: TransformerBlock.__call__(mdl, h, m, train=train),
+                    lambda mdl, h, m, km: TransformerBlock.__call__(
+                        mdl, h, m, kv_mask=km, train=train
+                    ),
                     prevent_cse=False,
                 )
-                x = apply(block, x, mask)
+                x = apply(block, x, mask, kv_mask)
             else:
-                x = block(x, mask, train=train)
+                x = block(x, mask, kv_mask=kv_mask, train=train)
         return x

@@ -24,6 +24,7 @@ def _xla_attention(
     k: jax.Array,
     v: jax.Array,
     mask: Optional[jax.Array],
+    kv_mask: Optional[jax.Array],
     causal: bool,
     softmax_scale: float,
 ) -> jax.Array:
@@ -38,8 +39,22 @@ def _xla_attention(
     if mask is not None:
         # mask: broadcastable to (B, N, Q, K); True = attend.
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    if kv_mask is not None:
+        # kv_mask: (B, K) key-padding validity; True/nonzero = attend.
+        logits = jnp.where(
+            kv_mask[:, None, None, :].astype(bool),
+            logits,
+            jnp.finfo(jnp.float32).min,
+        )
     weights = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bnqk,bknh->bqnh", weights.astype(v.dtype), v)
+    out = jnp.einsum("bnqk,bknh->bqnh", weights.astype(v.dtype), v)
+    if kv_mask is not None:
+        # batch rows with NO valid key: softmax over all-min logits yields
+        # a uniform average of V; emit zeros instead, matching the flash
+        # kernel's documented fully-padded behavior on every platform
+        any_valid = kv_mask.astype(bool).any(axis=-1)
+        out = jnp.where(any_valid[:, None, None, None], out, 0)
+    return out
 
 
 def dot_product_attention(
@@ -48,6 +63,7 @@ def dot_product_attention(
     v: jax.Array,
     *,
     mask: Optional[jax.Array] = None,
+    kv_mask: Optional[jax.Array] = None,
     causal: bool = False,
     softmax_scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
@@ -56,6 +72,9 @@ def dot_product_attention(
 
     Args:
       mask: optional boolean mask broadcastable to (B, N, Q, K); True=attend.
+        General masks take the XLA path (flash doesn't stream them).
+      kv_mask: optional (B, K) key-padding validity; True=attend. The form
+        real (padded) BERT batches need — supported by the flash kernel.
       causal: apply a causal mask (decoder LM).
       use_flash: force (True/False) or auto-select (None) the Pallas kernel.
     """
@@ -77,9 +96,10 @@ def dot_product_attention(
         from distributed_pytorch_example_tpu.ops.pallas import flash_attention
 
         return flash_attention.flash_attention(
-            q, k, v, causal=causal, softmax_scale=softmax_scale
+            q, k, v, causal=causal, kv_mask=kv_mask,
+            softmax_scale=softmax_scale,
         )
-    return _xla_attention(q, k, v, mask, causal, softmax_scale)
+    return _xla_attention(q, k, v, mask, kv_mask, causal, softmax_scale)
 
 
 @functools.lru_cache(maxsize=1)

@@ -62,9 +62,14 @@ def _apply_causal_mask(s, i, j, block_q, block_k):
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale: float, causal: bool, block_q: int, block_k: int,
+    *refs, scale: float, causal: bool, block_q: int, block_k: int,
+    has_mask: bool,
 ):
+    if has_mask:
+        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        mask_ref = None
     i, j = pl.program_id(2), pl.program_id(3)
     nj = pl.num_programs(3)
 
@@ -87,6 +92,9 @@ def _fwd_kernel(
         ) * scale  # (block_q, block_k)
         if causal:
             s = _apply_causal_mask(s, i, j, block_q, block_k)
+        if mask_ref is not None:
+            valid = mask_ref[0, 0] > 0.0  # (block_k,) key-padding validity
+            s = jnp.where(valid[None, :], s, NEG_INF)
         m_prev = m_ref[:, :1]  # (block_q, 1)
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -105,26 +113,43 @@ def _fwd_kernel(
     def _finalize():
         l = l_ref[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_ref[:, :1] + jnp.log(safe_l)
+        o = acc_ref[:] / safe_l
+        lse = m_ref[:, :1] + jnp.log(safe_l)
+        if mask_ref is not None:
+            # rows with no valid key: m never left NEG_INF and every p was
+            # exp(0)=1 garbage — emit 0 output and NEG_INF lse so the
+            # backward (which re-masks p) produces zero grads for them
+            dead = m_ref[:, :1] == NEG_INF
+            o = jnp.where(dead, 0.0, o)
+            lse = jnp.where(dead, NEG_INF, lse)
+        o_ref[0, 0] = o.astype(o_ref.dtype)
+        lse_ref[0, 0] = lse
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    # q, k, v: (B, N, S, H)
+def _fwd(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret):
+    # q, k, v: (B, N, S, H); kv_mask: (B, S_k) float 0/1 or None
     batch, heads, seq_q, head_dim = q.shape
     seq_k = k.shape[2]
     grid = (batch, heads, seq_q // block_q, seq_k // block_k)
 
     qspec = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i, j: (b, n, i, 0))
     kspec = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, n, i, j: (b, n, j, 0))
+    has_mask = kv_mask is not None
+    in_specs = [qspec, kspec, kspec]
+    inputs = [q, k, v]
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((1, 1, block_k), lambda b, n, i, j: (b, 0, j))
+        )
+        inputs.append(kv_mask)
 
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, has_mask=has_mask,
         ),
         grid=grid,
-        in_specs=[qspec, kspec, kspec],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i, j: (b, n, i, 0)),
             # lse rides as (B, N, S, 1): block (…, block_q, 1) satisfies the
@@ -132,8 +157,8 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, block_q, 1), lambda b, n, i, j: (b, n, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((batch, heads, seq_q, 1), jnp.float32),
+            _sds(q.shape, q.dtype, q),
+            _sds((batch, heads, seq_q, 1), jnp.float32, q),
         ],
         scratch_shapes=[
             _vmem((block_q, head_dim)),  # acc
@@ -141,8 +166,17 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             _vmem((block_q, 128)),       # running normalizer l
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return out, lse
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying ``like``'s varying-manual-axes set, so the
+    kernels compose with shard_map manual axes (ring attention's folds)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _vmem(shape, dtype=jnp.float32):
@@ -157,9 +191,14 @@ def _vmem(shape, dtype=jnp.float32):
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, scale: float, causal: bool, block_q: int, block_k: int,
+    *refs, scale: float, causal: bool, block_q: int, block_k: int,
+    has_mask: bool,
 ):
+    if has_mask:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, dq_ref, dq_acc = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
+        mask_ref = None
     i, j = pl.program_id(2), pl.program_id(3)
     nj = pl.num_programs(3)
 
@@ -183,6 +222,10 @@ def _dq_kernel(
         if causal:
             s = _apply_causal_mask(s, i, j, block_q, block_k)
         p = jnp.exp(s - lse)  # (block_q, block_k)
+        if mask_ref is not None:
+            # re-mask: for fully-padded rows lse is NEG_INF, making
+            # exp(s - lse) garbage instead of 0
+            p = jnp.where((mask_ref[0, 0] > 0.0)[None, :], p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -198,10 +241,16 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc,
-    *, scale: float, causal: bool, block_q: int, block_k: int,
+    *refs, scale: float, causal: bool, block_q: int, block_k: int,
+    has_mask: bool,
 ):
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        mask_ref = None
     j, i = pl.program_id(2), pl.program_id(3)  # k-block outer, q-block inner
     ni = pl.num_programs(3)
 
@@ -226,6 +275,8 @@ def _dkv_kernel(
         if causal:
             s = _apply_causal_mask(s, i, j, block_q, block_k)
         p = jnp.exp(s - lse)  # (block_q, block_k)
+        if mask_ref is not None:
+            p = jnp.where((mask_ref[0, 0] > 0.0)[None, :], p, 0.0)
         # dv += p^T @ dO
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -247,49 +298,65 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret):
+def _bwd(q, k, v, o, lse, do, kv_mask, causal, scale, block_q, block_k,
+         interpret, delta=None):
     batch, heads, seq_q, head_dim = q.shape
     seq_k = k.shape[2]
-    delta = jnp.sum(
-        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
-    )  # (B, N, S, 1), same carry layout as lse
+    if delta is None:
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+            keepdims=True,
+        )  # (B, N, S, 1), same carry layout as lse
+    # else: caller supplies the global delta (ring attention's chunk
+    # backward, where o/do span ALL chunks but this call sees one)
+    has_mask = kv_mask is not None
 
     qspec = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i, j: (b, n, i, 0))
     kspec = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, n, i, j: (b, n, j, 0))
     rowspec = pl.BlockSpec((1, 1, block_q, 1), lambda b, n, i, j: (b, n, i, 0))
 
+    in_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
+    inputs = [q, k, v, do, lse, delta]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((1, 1, block_k), lambda b, n, i, j: (b, 0, j)))
+        inputs.append(kv_mask)
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, has_mask=has_mask,
         ),
         grid=(batch, heads, seq_q // block_q, seq_k // block_k),
-        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        in_specs=in_specs,
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=_sds(q.shape, q.dtype, q),
         scratch_shapes=[_vmem((block_q, head_dim))],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*inputs)
 
     # k-block-major grid: q streams innermost
     qspec_t = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, j, i: (b, n, i, 0))
     kspec_t = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, n, j, i: (b, n, j, 0))
     rowspec_t = pl.BlockSpec((1, 1, block_q, 1), lambda b, n, j, i: (b, n, i, 0))
+    in_specs_t = [qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t, rowspec_t]
+    inputs_t = [q, k, v, do, lse, delta]
+    if has_mask:
+        in_specs_t.append(pl.BlockSpec((1, 1, block_k), lambda b, n, j, i: (b, 0, j)))
+        inputs_t.append(kv_mask)
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, has_mask=has_mask,
         ),
         grid=(batch, heads, seq_k // block_k, seq_q // block_q),
-        in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t, rowspec_t],
+        in_specs=in_specs_t,
         out_specs=[kspec_t, kspec_t],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            _sds(k.shape, k.dtype, q),
+            _sds(v.shape, v.dtype, q),
         ],
         scratch_shapes=[_vmem((block_k, head_dim)), _vmem((block_k, head_dim))],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*inputs_t)
     return dq, dk, dv
 
 
@@ -298,20 +365,25 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, _ = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, kv_mask, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
-    q, k, v, out, lse = residuals
-    return _bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret)
+    q, k, v, kv_mask, out, lse = residuals
+    dq, dk, dv = _bwd(
+        q, k, v, out, lse, g, kv_mask, causal, scale, block_q, block_k,
+        interpret,
+    )
+    dmask = None if kv_mask is None else jnp.zeros_like(kv_mask)
+    return dq, dk, dv, dmask
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -323,12 +395,18 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = False,
+    kv_mask: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
     """Fused flash attention; (B, S, N, H) in and out.
+
+    ``kv_mask``: optional (B, S_k) key-padding validity (True/nonzero =
+    attend), the masking real BERT batches need (reference-scope extension;
+    the reference has no attention at all). Queries whose keys are ALL
+    masked produce zero output and zero gradients.
 
     Sequence lengths must be multiples of the block sizes (the dispatcher in
     ops/attention.py guarantees this before selecting the flash path; blocks
@@ -347,7 +425,17 @@ def flash_attention(
             f"seq lengths ({seq_q}, {seq_k}) must divide by blocks "
             f"({block_q}, {block_k})"
         )
+    if kv_mask is not None:
+        if kv_mask.shape != (q.shape[0], seq_k):
+            raise ValueError(
+                f"kv_mask shape {kv_mask.shape} != (batch, seq_k) "
+                f"({q.shape[0]}, {seq_k})"
+            )
+        kv_mask = kv_mask.astype(jnp.float32)[:, None, :]  # (B, 1, S_k): TPU tile-rule-friendly block shape
     # (B, S, N, H) -> (B, N, S, H)
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    out = _flash(qt, kt, vt, causal, float(softmax_scale), block_q, block_k, interpret)
+    out = _flash(
+        qt, kt, vt, kv_mask, causal, float(softmax_scale), block_q, block_k,
+        interpret,
+    )
     return out.transpose(0, 2, 1, 3)

@@ -6,13 +6,24 @@ a first-class capability: when the sequence is sharded across devices on a
 ``sequence`` mesh axis, no device ever materializes full-sequence K/V.
 Instead K/V chunks rotate around the ring via ``lax.ppermute`` (compiled to
 ICI neighbor transfers) while each device folds every chunk into its local
-queries' online softmax — the same math as the flash kernel's k-block loop,
-lifted to the inter-chip level. Compute for the current chunk overlaps with
-the transfer of the next (XLA's latency-hiding scheduler handles it since
-the ppermute has no data dependence on the chunk attention).
+queries' running (output, logsumexp) pair. Compute for the current chunk
+overlaps with the transfer of the next (XLA's latency-hiding scheduler
+handles it since the ppermute has no data dependence on the chunk fold).
 
-Memory per device: O(S_local * S_local) logits per step instead of O(S^2)
-— sequence length scales linearly with ring size.
+Memory — forward AND backward — is O(S_local) per device:
+
+- *forward*: each fold produces a normalized chunk output plus its
+  logsumexp, merged into the running pair (``o·e^{lse-lse'} + o_i·e^{...}``);
+  only (o, lse) persist between folds. Local folds use the Pallas flash
+  kernel on TPU (O(block) VMEM, no S_local² logits in HBM) and an XLA
+  softmax otherwise.
+- *backward*: a ``custom_vjp`` replays the ring, recomputing each chunk's
+  attention weights blockwise from the saved global ``lse`` (the flash
+  delta trick lifted to the inter-chip level): dK/dV accumulators travel
+  around the ring *with* their K/V chunk and arrive home after a full
+  rotation. Without this, reverse-mode AD through the forward scan would
+  save every fold's softmax weights — O(S_local · S_global) residuals,
+  the very footprint ring attention exists to avoid.
 
 ``ring_attention`` is the per-device collective program (call under
 ``shard_map``); ``ring_attention_sharded`` wraps it for callers holding
@@ -22,7 +33,7 @@ global arrays.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +41,294 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# per-chunk local attention: (o, lse) forward, (dq, dk, dv) backward
+# ---------------------------------------------------------------------------
+
+
+def _pos_mask(idx, src, s_loc):
+    """(s_loc, s_loc) bool: global causal validity of (local q, chunk k)."""
+    q_pos = idx * s_loc + lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+    k_pos = src * s_loc + lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+    return (q_pos >= k_pos)[None, :, None, :]
+
+
+def _chunk_fwd_xla(q, k, v, scale, causal, idx, src):
+    """Normalized chunk attention + lse in XLA ops; (B,S,N,H) ring layout.
+
+    Rows with no valid key (chunk entirely above the causal diagonal) emit
+    lse ≈ NEG_INF, so their garbage output vanishes in the lse merge.
+    """
+    logits = jnp.einsum(
+        "bqnh,bknh->bqnk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        logits = jnp.where(_pos_mask(idx, src, q.shape[1]), logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bqnk,bknh->bqnh", p, v.astype(jnp.float32)) / l
+    return o, m + jnp.log(l)  # lse: (B, S, N, 1)
+
+
+def _chunk_bwd_xla(q, k, v, g, lse, delta, scale, causal, idx, src):
+    """Chunk grads from the saved global lse; all math in float32."""
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    gf = g.astype(jnp.float32)
+    logits = jnp.einsum("bqnh,bknh->bqnk", qf, kf) * scale
+    if causal:
+        logits = jnp.where(_pos_mask(idx, src, q.shape[1]), logits, NEG_INF)
+    # p: GLOBAL softmax weights for this chunk's keys (lse spans all chunks)
+    p = jnp.exp(logits - lse)
+    dv = jnp.einsum("bqnk,bqnh->bknh", p, gf)
+    dp = jnp.einsum("bqnh,bknh->bqnk", gf, vf)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bqnk,bknh->bqnh", ds, kf)
+    dk = jnp.einsum("bqnk,bqnh->bknh", ds, qf)
+    return dq, dk, dv
+
+
+def _chunk_fwd_flash(q, k, v, scale, causal, idx, src, interpret):
+    """Pallas-flash chunk fold: O(block) VMEM, returns (o f32, lse).
+
+    The (idx, src) relation picks the static kernel variant via
+    ``lax.switch``: fully-visible chunk (non-causal kernel), diagonal chunk
+    (causal kernel — local offsets coincide so the local mask is exact),
+    or fully-masked chunk (skip: zero output at lse=NEG_INF merges to a
+    no-op).
+    """
+    from distributed_pytorch_example_tpu.ops.pallas.flash_attention import (
+        _fit_block,
+        _fwd,
+    )
+
+    s_loc = q.shape[1]
+    block = _fit_block(s_loc, 512)  # must DIVIDE s_loc, not just cap it
+
+    def run(causal_flag):
+        def f(q, k, v):
+            qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+            out, lse = _fwd(
+                qt, kt, vt, None, causal_flag, scale, block, block, interpret
+            )
+            return (
+                out.transpose(0, 2, 1, 3).astype(jnp.float32),
+                lse.transpose(0, 2, 1, 3),  # (B, N, S, 1) -> (B, S, N, 1)
+            )
+
+        return f
+
+    if not causal:
+        return run(False)(q, k, v)
+
+    def skip(q, k, v):
+        from distributed_pytorch_example_tpu.parallel.api import pvary_like
+
+        b, s, n, h = q.shape
+        return pvary_like(
+            (
+                jnp.zeros((b, s, n, h), jnp.float32),
+                jnp.full((b, s, n, 1), NEG_INF, jnp.float32),
+            ),
+            q,
+        )
+
+    mode = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+    return lax.switch(mode, [run(False), run(True), skip], q, k, v)
+
+
+def _chunk_bwd_flash(q, k, v, g, lse, delta, scale, causal, idx, src, interpret):
+    """Pallas-flash chunk backward from the global lse/delta."""
+    from distributed_pytorch_example_tpu.ops.pallas.flash_attention import (
+        _bwd,
+        _fit_block,
+    )
+
+    s_loc = q.shape[1]
+    block = _fit_block(s_loc, 512)  # must DIVIDE s_loc, not just cap it
+
+    def run(causal_flag):
+        def f(q, k, v, g, lse, delta):
+            qt, kt, vt, gt = (x.transpose(0, 2, 1, 3) for x in (q, k, v, g))
+            dq, dk, dv = _bwd(
+                qt, kt, vt, None, lse.transpose(0, 2, 1, 3), gt, None,
+                causal_flag, scale, block, block, interpret,
+                delta=delta.transpose(0, 2, 1, 3),
+            )
+            return tuple(
+                x.transpose(0, 2, 1, 3).astype(jnp.float32)
+                for x in (dq, dk, dv)
+            )
+
+        return f
+
+    if not causal:
+        return run(False)(q, k, v, g, lse, delta)
+
+    def skip(q, k, v, g, lse, delta):
+        from distributed_pytorch_example_tpu.parallel.api import pvary_like
+
+        return pvary_like(
+            (
+                jnp.zeros(q.shape, jnp.float32),
+                jnp.zeros(k.shape, jnp.float32),
+                jnp.zeros(v.shape, jnp.float32),
+            ),
+            q,
+        )
+
+    mode = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+    return lax.switch(
+        mode, [run(False), run(True), skip], q, k, v, g, lse, delta
+    )
+
+
+# ---------------------------------------------------------------------------
+# the ring program (custom VJP)
+# ---------------------------------------------------------------------------
+
+
+def _merge(o, lse, o_i, lse_i):
+    """Merge two normalized (output, logsumexp) pairs."""
+    lse_n = jnp.logaddexp(lse, lse_i)
+    return (
+        o * jnp.exp(lse - lse_n) + o_i * jnp.exp(lse_i - lse_n),
+        lse_n,
+    )
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal, scale, flash, interpret):
+    n_chunks = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    batch, s_loc, heads, head_dim = q.shape
+    shift = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
+
+    chunk_fwd = _chunk_fwd_flash if flash else _chunk_fwd_xla
+
+    def fold(o, lse, k_cur, v_cur, src):
+        if flash:
+            o_i, lse_i = chunk_fwd(q, k_cur, v_cur, scale, causal, idx, src,
+                                   interpret)
+        else:
+            o_i, lse_i = chunk_fwd(q, k_cur, v_cur, scale, causal, idx, src)
+        return _merge(o, lse, o_i, lse_i)
+
+    o0 = jnp.zeros((batch, s_loc, heads, head_dim), jnp.float32)
+    lse0 = jnp.full((batch, s_loc, heads, 1), NEG_INF, jnp.float32)
+    from distributed_pytorch_example_tpu.parallel.api import pvary_like
+
+    o0, lse0 = pvary_like((o0, lse0), q)
+
+    def body(carry, step):
+        k_cur, v_cur, o, lse = carry
+        # start rotating the chunk we hold, then fold it: the transfer has
+        # no dependence on the fold, so XLA overlaps them
+        k_nxt = lax.ppermute(k_cur, axis_name, shift)
+        v_nxt = lax.ppermute(v_cur, axis_name, shift)
+        src = (idx - step) % n_chunks  # ring owner of the chunk we hold
+        o, lse = fold(o, lse, k_cur, v_cur, src)
+        return (k_nxt, v_nxt, o, lse), None
+
+    if n_chunks > 1:
+        # scan folds chunks 0..n-2 with rotation; the last chunk folds
+        # outside so the ring makes exactly n-1 transfers (none discarded)
+        (k_last, v_last, o, lse), _ = lax.scan(
+            body, (k, v, o0, lse0), jnp.arange(n_chunks - 1)
+        )
+        o, lse = fold(o, lse, k_last, v_last, (idx - (n_chunks - 1)) % n_chunks)
+    else:
+        o, lse = fold(o0, lse0, k, v, idx)
+    return o.astype(q.dtype), lse
+
+
+def _ring_bwd_impl(q, k, v, out, lse, g, axis_name, causal, scale, flash,
+                   interpret):
+    n_chunks = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    shift = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    def chunk_bwd(k_cur, v_cur, src):
+        if flash:
+            return _chunk_bwd_flash(
+                q, k_cur, v_cur, g, lse, delta, scale, causal, idx, src,
+                interpret,
+            )
+        return _chunk_bwd_xla(
+            q, k_cur, v_cur, g, lse, delta, scale, causal, idx, src
+        )
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    from distributed_pytorch_example_tpu.parallel.api import pvary_like
+
+    dq0, dk0, dv0 = pvary_like((dq0, dk0, dv0), q)
+
+    def accumulate(carry, step):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        src = (idx - step) % n_chunks
+        dq_i, dk_i, dv_i = chunk_bwd(k_cur, v_cur, src)
+        # dK/dV accumulators travel WITH their chunk: after the full
+        # rotation (n_chunks steps) they arrive back at the chunk's owner
+        return k_cur, v_cur, dk_cur + dk_i, dv_cur + dv_i, dq + dq_i
+
+    def body(carry, step):
+        k_cur, v_cur, dk_cur, dv_cur, dq = accumulate(carry, step)
+        k_cur = lax.ppermute(k_cur, axis_name, shift)
+        v_cur = lax.ppermute(v_cur, axis_name, shift)
+        dk_cur = lax.ppermute(dk_cur, axis_name, shift)
+        dv_cur = lax.ppermute(dv_cur, axis_name, shift)
+        return (k_cur, v_cur, dk_cur, dv_cur, dq), None
+
+    carry = (k, v, dk0, dv0, dq0)
+    if n_chunks > 1:
+        # last step outside the scan: the K/V shards are done after it, so
+        # only the dK/dV accumulators take the final homeward transfer
+        carry, _ = lax.scan(body, carry, jnp.arange(n_chunks - 1))
+    _, _, dk, dv, dq = accumulate(carry, n_chunks - 1)
+    if n_chunks > 1:
+        dk = lax.ppermute(dk, axis_name, shift)
+        dv = lax.ppermute(dv, axis_name, shift)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring(q, k, v, axis_name, causal, scale, flash, interpret):
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal, scale, flash, interpret)
+    return out
+
+
+def _ring_fwd(q, k, v, axis_name, causal, scale, flash, interpret):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal, scale, flash, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis_name, causal, scale, flash, interpret, residuals, g):
+    q, k, v, out, lse = residuals
+    return _ring_bwd_impl(
+        q, k, v, out, lse, g, axis_name, causal, scale, flash, interpret
+    )
+
+
+_ring.defvjp(_ring_fwd, _ring_bwd)
+
+
+def _flash_viable(q, interpret: bool) -> bool:
+    """Static check: can the Pallas kernels serve the local folds?"""
+    from distributed_pytorch_example_tpu.ops.attention import _on_tpu
+
+    s_loc, head_dim = q.shape[1], q.shape[-1]
+    shapes_ok = (
+        s_loc % 128 == 0
+        and head_dim in (64, 128, 256)
+        and q.dtype in (jnp.float32, jnp.bfloat16)
+    )
+    return shapes_ok and (interpret or _on_tpu())
 
 
 def ring_attention(
@@ -40,6 +339,8 @@ def ring_attention(
     *,
     causal: bool = False,
     softmax_scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
+    flash_interpret: bool = False,
 ) -> jax.Array:
     """Exact attention with K/V ring rotation; call inside ``shard_map``.
 
@@ -48,73 +349,28 @@ def ring_attention(
         the sequence dimension over ``axis_name``.
       causal: global causal masking — positions are reconstructed from the
         ring index, so the mask is exact across shard boundaries.
+      use_flash: None = auto (Pallas local folds on TPU when shapes allow),
+        True/False = force. ``flash_interpret`` runs the Pallas kernels in
+        interpret mode (CPU tests of the flash-in-ring path).
 
     Returns the local output shard (batch, seq_local, heads, head_dim).
     """
     if softmax_scale is None:
         softmax_scale = q.shape[-1] ** -0.5
-    n_chunks = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
-    batch, s_loc, heads, head_dim = q.shape
-    qf = q.astype(jnp.float32)
-
-    def fold_chunk(m, l, acc, k_cur, v_cur, src):
-        """Fold one K/V chunk into the running online softmax."""
-        logits = jnp.einsum(
-            "bqnh,bknh->bqnk", qf, k_cur.astype(jnp.float32)
-        ) * softmax_scale
-        if causal:
-            q_pos = idx * s_loc + lax.broadcasted_iota(
-                jnp.int32, (s_loc, s_loc), 0
-            )
-            k_pos = src * s_loc + lax.broadcasted_iota(
-                jnp.int32, (s_loc, s_loc), 1
-            )
-            mask = (q_pos >= k_pos)[None, :, None, :]
-            logits = jnp.where(mask, logits, NEG_INF)
-        m_cur = jnp.max(logits, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, m_cur)
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(logits - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.einsum(
-            "bqnk,bknh->bqnh", p, v_cur.astype(jnp.float32)
-        )
-        return m_new, l_new, acc_new
-
-    m0 = jnp.full((batch, s_loc, heads, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((batch, s_loc, heads, 1), jnp.float32)
-    acc0 = jnp.zeros((batch, s_loc, heads, head_dim), jnp.float32)
-    # mark the constant carries as device-varying so the scan carry type
-    # matches the (varying) per-step outputs under shard_map's vma tracking
-    from distributed_pytorch_example_tpu.parallel.api import pvary_like
-
-    m0, l0, acc0 = pvary_like((m0, l0, acc0), q)
-    shift = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
-
-    def body(carry, step):
-        k_cur, v_cur, m, l, acc = carry
-        # start rotating the chunk we hold, then fold it: the transfer has
-        # no dependence on the fold, so XLA overlaps them
-        k_nxt = lax.ppermute(k_cur, axis_name, shift)
-        v_nxt = lax.ppermute(v_cur, axis_name, shift)
-        src = (idx - step) % n_chunks  # ring owner of the chunk we hold
-        m, l, acc = fold_chunk(m, l, acc, k_cur, v_cur, src)
-        return (k_nxt, v_nxt, m, l, acc), None
-
-    if n_chunks > 1:
-        # scan folds chunks 0..n-2 with rotation; the last chunk folds
-        # outside so the ring makes exactly n-1 transfers (none discarded)
-        (k_last, v_last, m, l, acc), _ = lax.scan(
-            body, (k, v, m0, l0, acc0), jnp.arange(n_chunks - 1)
-        )
-        m, l, acc = fold_chunk(
-            m, l, acc, k_last, v_last, (idx - (n_chunks - 1)) % n_chunks
-        )
+    if use_flash is None:
+        flash = _flash_viable(q, flash_interpret)
     else:
-        m, l, acc = fold_chunk(m0, l0, acc0, k, v, idx)
-    safe_l = jnp.where(l == 0.0, 1.0, l)
-    return (acc / safe_l).astype(q.dtype)
+        flash = use_flash
+        if flash and not _flash_viable(q, flash_interpret):
+            raise ValueError(
+                "use_flash=True but the flash kernel cannot serve these "
+                f"ring shapes (seq_local {q.shape[1]}, head_dim "
+                f"{q.shape[-1]}, dtype {q.dtype})"
+            )
+    return _ring(
+        q, k, v, axis_name, causal, float(softmax_scale), flash,
+        flash_interpret,
+    )
 
 
 def ring_attention_sharded(
@@ -128,6 +384,7 @@ def ring_attention_sharded(
     heads_axis: str = "tensor",
     causal: bool = False,
     softmax_scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Ring attention on global (B, S, N, H) arrays: shard, ring, unshard.
 
@@ -150,6 +407,7 @@ def ring_attention_sharded(
             axis_name=seq_axis,
             causal=causal,
             softmax_scale=softmax_scale,
+            use_flash=use_flash,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

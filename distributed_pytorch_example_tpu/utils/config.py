@@ -54,6 +54,9 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     parser.add_argument("--pipe-microbatches", type=int, default=0,
                         help="microbatches per pipelined step (0 = auto; "
                         "must divide batch and be a multiple of --mesh-pipe)")
+    parser.add_argument("--pad-token-id", type=int, default=None,
+                        help="bert: mask keys at this token id out of "
+                        "attention (padding); default: no padding mask")
     parser.add_argument("--moe-experts", type=int, default=0,
                         help=">0: MoE MLP with this many experts on every "
                         "other transformer block (gpt2)")

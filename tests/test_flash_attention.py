@@ -28,7 +28,7 @@ def make_qkv(batch=2, seq=256, heads=2, head_dim=64, seed=0):
 def test_forward_matches_xla(causal, seq):
     q, k, v = make_qkv(seq=seq)
     scale = q.shape[-1] ** -0.5
-    expected = _xla_attention(q, k, v, None, causal, scale)
+    expected = _xla_attention(q, k, v, None, None, causal, scale)
     got = flash_attention(q, k, v, causal=causal, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
 
@@ -39,7 +39,7 @@ def test_grads_match_xla(causal):
     scale = q.shape[-1] ** -0.5
 
     def loss_ref(q, k, v):
-        return jnp.sum(_xla_attention(q, k, v, None, causal, scale) ** 2)
+        return jnp.sum(_xla_attention(q, k, v, None, None, causal, scale) ** 2)
 
     def loss_flash(q, k, v):
         return jnp.sum(flash_attention(q, k, v, causal=causal, interpret=True) ** 2)
@@ -62,7 +62,7 @@ def test_small_seq_shrinks_blocks():
     # seq < block: block shrinks to seq, single-block path
     q, k, v = make_qkv(seq=64)
     scale = q.shape[-1] ** -0.5
-    expected = _xla_attention(q, k, v, None, True, scale)
+    expected = _xla_attention(q, k, v, None, None, True, scale)
     got = flash_attention(q, k, v, causal=True, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
 
@@ -85,3 +85,95 @@ def test_causal_cross_length_not_auto_selected():
     q, _, _ = make_qkv(seq=128)
     k, v, _ = make_qkv(seq=256)
     assert _flash_unsupported_reason(q, k, v, None, True) is not None
+
+
+def make_kv_mask(batch=2, seq=256, seed=5, min_valid=1):
+    """Random key-padding mask with >= min_valid valid keys per row."""
+    rng = np.random.default_rng(seed)
+    mask = rng.random((batch, seq)) > 0.3
+    mask[:, :min_valid] = True  # no fully-padded rows by default
+    return jnp.asarray(mask)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_kv_mask_forward_matches_xla(causal):
+    q, k, v = make_qkv(seq=256)
+    kv_mask = make_kv_mask(seq=256)
+    scale = q.shape[-1] ** -0.5
+    expected = _xla_attention(q, k, v, None, kv_mask, causal, scale)
+    got = flash_attention(
+        q, k, v, causal=causal, kv_mask=kv_mask, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_kv_mask_grads_match_xla():
+    q, k, v = make_qkv(seq=256, seed=7)
+    kv_mask = make_kv_mask(seq=256, seed=8)
+    scale = q.shape[-1] ** -0.5
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, None, kv_mask, False, scale) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, kv_mask=kv_mask, interpret=True) ** 2
+        )
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ref, g_flash, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+def test_kv_mask_fully_padded_batch_row_is_finite():
+    """A batch row with ZERO valid keys: zero output, zero grads, no NaNs."""
+    q, k, v = make_qkv(seq=128, seed=9)
+    mask = np.ones((2, 128), bool)
+    mask[1, :] = False  # batch row 1 fully padded
+    kv_mask = jnp.asarray(mask)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, kv_mask=kv_mask, interpret=True) ** 2
+        )
+
+    out = flash_attention(q, k, v, kv_mask=kv_mask, interpret=True)
+    assert np.all(np.isfinite(np.asarray(out)))
+    np.testing.assert_array_equal(np.asarray(out)[1], 0.0)
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g, name in zip(grads, "qkv"):
+        g = np.asarray(g)
+        assert np.all(np.isfinite(g)), f"d{name} has non-finite values"
+        np.testing.assert_array_equal(g[1], 0.0, err_msg=f"d{name} row 1")
+
+
+def test_kv_mask_via_dispatcher_keeps_xla_on_cpu():
+    """kv_mask through dot_product_attention matches the masked reference."""
+    from distributed_pytorch_example_tpu.ops.attention import (
+        dot_product_attention,
+    )
+
+    q, k, v = make_qkv(seq=128)
+    kv_mask = make_kv_mask(seq=128)
+    scale = q.shape[-1] ** -0.5
+    expected = _xla_attention(q, k, v, None, kv_mask, False, scale)
+    got = dot_product_attention(q, k, v, kv_mask=kv_mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_fully_padded_rows_zero_on_xla_path_too():
+    """XLA and flash paths must agree on fully-padded rows (both zero)."""
+    q, k, v = make_qkv(seq=128, seed=11)
+    mask = np.ones((2, 128), bool)
+    mask[0, :] = False
+    kv_mask = jnp.asarray(mask)
+    scale = q.shape[-1] ** -0.5
+    xla = _xla_attention(q, k, v, None, kv_mask, False, scale)
+    np.testing.assert_array_equal(np.asarray(xla)[0], 0.0)
+    flash = flash_attention(q, k, v, kv_mask=kv_mask, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(flash), np.asarray(xla), atol=2e-5
+    )

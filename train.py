@@ -122,6 +122,15 @@ def main():
             overrides["use_flash"] = args.flash == "on"
         if args.mesh_sequence not in (0, 1):
             overrides["seq_axis"] = "sequence"  # ring attention over the mesh
+    if args.pad_token_id is not None:
+        if not args.model.startswith("bert"):
+            parser.error(f"--pad-token-id is only supported for bert models, "
+                         f"not {args.model!r}")
+        if args.mesh_sequence not in (0, 1):
+            parser.error("--pad-token-id cannot combine with --mesh-sequence "
+                         "> 1: the ring-attention path has no padding-mask "
+                         "support yet")
+        overrides["pad_token_id"] = args.pad_token_id
     if args.moe_experts:
         if not args.model.startswith("gpt"):
             parser.error(f"--moe-experts is only supported for gpt2 models, "
