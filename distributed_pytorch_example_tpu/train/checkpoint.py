@@ -4,25 +4,39 @@ Parity contract (reference train.py:178-209, 252-308; SURVEY.md §3.4):
 
 - the on-disk checkpoint is a SINGLE-LOGICAL-VIEW of the model — the analogue
   of the reference's DDP-unwrapped state dict (train.py:181-183). Sharded
-  state (FSDP/TP) is gathered to full arrays before writing, so a checkpoint
-  written at one parallelism config restores at any other;
+  state (FSDP/TP) restores at any other parallelism config;
 - payload = {epoch, state (params + optimizer + mutable model state + rng),
   loss} — optimizer state included, matching train.py:185-190;
-- host 0 writes, every host reads (train.py:253,256) — but gathering is a
-  collective, so ALL hosts enter :func:`save_checkpoint`;
+- host 0 writes, every host reads (train.py:253,256);
 - writes are atomic (tmp + rename) so a killed job never leaves a torn
   ``latest`` checkpoint;
 - resume restarts at the saved epoch (train.py:209,257): step-level state is
   in ``state.step``, epoch granularity is the loop contract.
 
-Format: flax msgpack serialization of the state-dict pytree. No torch, no
-pickle — portable and introspectable.
+Two on-disk formats, both flax-msgpack (no torch, no pickle — portable and
+introspectable), auto-detected on load:
+
+- **gathered** (default; single file): sharded state is all-gathered to
+  full arrays and host 0 writes one msgpack blob. Maximum portability,
+  but the gather is a collective (all hosts must enter) and re-materializes
+  the full model — the wrong trade at FSDP/multi-host scale.
+- **sharded** (directory + pointer file): every process independently
+  fetches only the addressable shards it owns (replica 0 of each) and
+  writes its own shard file — NO collectives, so it is safe from the
+  async background thread at any process count, and no host ever holds
+  the full state. Process 0 commits the checkpoint by writing the
+  manifest after all shard files land (a filesystem rendezvous, not a
+  barrier) and atomically flipping a pointer file. The loader reassembles
+  global leaves and re-shards onto the target mesh, so a checkpoint saved
+  under one mesh shape restores under any other.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
 import threading
+import time
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -37,6 +51,11 @@ logger = get_logger(__name__)
 BEST_NAME = "best_model.ckpt"
 LATEST_NAME = "latest_model.ckpt"
 
+# pointer-file magic marking the sharded format (a gathered checkpoint is
+# raw msgpack, which can never begin with this line)
+SHARDED_MAGIC = b"DPX-SHARDED-V1\n"
+SHARD_WAIT_TIMEOUT_S = 600.0
+
 
 class AsyncSaver:
     """Runs checkpoint writes on a background thread, one in flight.
@@ -47,9 +66,12 @@ class AsyncSaver:
     copy, immune to later donation) and hands the fetch+serialize+write to
     this saver, so training continues while the checkpoint drains.
 
-    Single-process only: multi-host gathering is a collective and must not
-    race train-step collectives from another thread — the Trainer falls
-    back to synchronous saves when ``jax.process_count() > 1``.
+    Works at any process count for the SHARDED format (its writes are
+    collective-free; the begin-of-save barrier runs on the main thread in
+    ``save_checkpoint`` before submission). The GATHERED format needs a
+    collective all-gather, which must not race train-step collectives from
+    another thread, so it backgrounds only at ``jax.process_count() == 1``
+    and is synchronous multi-host.
     """
 
     def __init__(self):
@@ -109,12 +131,212 @@ def _write_payload(path: str, host_state, epoch: int, loss: float, extra) -> Non
         "state": serialization.to_state_dict(host_state),
         "extra": extra or {},
     }
-    blob = serialization.msgpack_serialize(payload)
+    _atomic_write(path, serialization.msgpack_serialize(payload))
+    logger.info("Checkpoint saved to %s", path)
+
+
+# ---------------------------------------------------------------------------
+# sharded format
+# ---------------------------------------------------------------------------
+
+
+def _path_str(key_path) -> str:
+    parts = []
+    for p in key_path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(p, attr):
+                parts.append(str(getattr(p, attr)))
+                break
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _raw_leaves(tree: Any) -> Any:
+    """Typed PRNG keys → raw uint32 data (shape-stable flatten basis)."""
+
+    def pre(x):
+        if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+            return jax.random.key_data(x)
+        return x
+
+    return jax.tree_util.tree_map(pre, tree)
+
+
+def _atomic_write(path: str, blob: bytes) -> None:
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(blob)
     os.replace(tmp, path)
-    logger.info("Checkpoint saved to %s", path)
+
+
+def _version(epoch: int) -> str:
+    return f"{epoch:08d}"
+
+
+def _begin_sharded_save(path: str, epoch: int) -> None:
+    """Main-thread prologue making the filesystem rendezvous sound.
+
+    A step_dir surviving a crashed save (or an identical rerun) would let
+    process 0's wait loop see the OLD shard files and commit a manifest
+    over a torn old/new mix. Process 0 deletes any such dir, and a barrier
+    ensures no process starts writing before the cleanup — the barrier is
+    cheap and runs on the main thread, so the expensive fetch/serialize/
+    write still backgrounds collective-free.
+    """
+    from distributed_pytorch_example_tpu.runtime import distributed as dist
+
+    step_dir = os.path.join(f"{path}.shards", _version(epoch))
+    if jax.process_index() == 0 and os.path.isdir(step_dir):
+        shutil.rmtree(step_dir, ignore_errors=True)
+    if jax.process_count() > 1:
+        dist.barrier(f"ckpt-begin-{os.path.basename(path)}-{epoch}")
+
+
+def _save_sharded(path: str, state: Any, epoch: int, loss: float, extra) -> None:
+    """Collective-free sharded save; every process writes only its shards.
+
+    Layout: ``{path}.shards/{epoch:08d}/shard_{proc}.msgpack`` plus a
+    ``manifest.msgpack`` committed by process 0 once every shard file has
+    landed (filesystem rendezvous on the shared checkpoint store — the
+    reference's all-ranks-read contract presumes one, train.py:253,256).
+    ``{path}`` itself becomes a small pointer file flipped atomically last,
+    so readers never observe a torn checkpoint.
+    """
+    proc, nproc = jax.process_index(), jax.process_count()
+    step_dir = os.path.join(f"{path}.shards", _version(epoch))
+    os.makedirs(step_dir, exist_ok=True)
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(_raw_leaves(state))
+    chunks: dict = {}
+    meta: dict = {}
+    host_leaves: dict = {}
+    for key_path, leaf in flat:
+        p = _path_str(key_path)
+        if not isinstance(leaf, jax.Array):
+            host_leaves[p] = np.asarray(leaf)
+            continue
+        meta[p] = {"shape": list(leaf.shape), "dtype": str(leaf.dtype)}
+        for shard in leaf.addressable_shards:
+            if shard.replica_id != 0:
+                continue  # exactly one device globally owns replica 0
+            starts = [
+                int(s.start) if s.start is not None else 0 for s in shard.index
+            ]
+            chunks.setdefault(p, []).append(
+                {"start": starts, "data": np.asarray(shard.data)}
+            )
+    _atomic_write(
+        os.path.join(step_dir, f"shard_{proc:05d}.msgpack"),
+        serialization.msgpack_serialize(chunks),
+    )
+
+    if proc != 0:
+        return
+    deadline = time.monotonic() + SHARD_WAIT_TIMEOUT_S
+    missing = [
+        os.path.join(step_dir, f"shard_{i:05d}.msgpack") for i in range(nproc)
+    ]
+    while missing:
+        missing = [f for f in missing if not os.path.exists(f)]
+        if not missing:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"sharded checkpoint: {len(missing)} shard files still "
+                f"missing after {SHARD_WAIT_TIMEOUT_S}s: {missing[:3]}..."
+            )
+        time.sleep(0.1)
+    manifest = {
+        "epoch": epoch,
+        "loss": float(loss),
+        "extra": extra or {},
+        "nproc": nproc,
+        "leaves": meta,
+        "host_leaves": host_leaves,
+    }
+    _atomic_write(
+        os.path.join(step_dir, "manifest.msgpack"),
+        serialization.msgpack_serialize(manifest),
+    )
+    _atomic_write(path, SHARDED_MAGIC + _version(epoch).encode())
+    # GC: only the pointed-to version is live for THIS pointer; older
+    # sibling versions under this base are dead (every process's writes to
+    # them finished before this commit — per-process saves are ordered)
+    base = f"{path}.shards"
+    for name in os.listdir(base):
+        if name != _version(epoch):
+            shutil.rmtree(os.path.join(base, name), ignore_errors=True)
+    logger.info(
+        "Sharded checkpoint saved to %s (version %s)", path, _version(epoch)
+    )
+
+
+def _load_sharded(path: str, state_template: Any, shardings) -> Tuple[Any, int, dict]:
+    with open(path, "rb") as f:
+        version = f.read()[len(SHARDED_MAGIC):].decode().strip()
+    step_dir = os.path.join(f"{path}.shards", version)
+    with open(os.path.join(step_dir, "manifest.msgpack"), "rb") as f:
+        manifest = serialization.msgpack_restore(f.read())
+
+    buffers = {
+        p: np.empty(tuple(m["shape"]), np.dtype(m["dtype"]))
+        for p, m in manifest["leaves"].items()
+    }
+    for i in range(int(manifest["nproc"])):
+        with open(
+            os.path.join(step_dir, f"shard_{i:05d}.msgpack"), "rb"
+        ) as f:
+            chunks = serialization.msgpack_restore(f.read())
+        for p, entries in chunks.items():
+            for entry in entries:
+                data = np.asarray(entry["data"])
+                idx = tuple(
+                    slice(int(s), int(s) + d)
+                    for s, d in zip(entry["start"], data.shape)
+                )
+                buffers[p][idx] = data
+
+    if shardings is None:
+        shardings = jax.tree_util.tree_map(
+            lambda t: t.sharding if isinstance(t, jax.Array) else None,
+            state_template,
+        )
+    flat_t, treedef = jax.tree_util.tree_flatten_with_path(state_template)
+    # None IS a valid per-leaf sharding entry ("leave on host"); a plain
+    # tree_leaves would silently drop it and misalign the zip below
+    flat_s = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: x is None
+    )
+    restored = []
+    for (key_path, tmpl), sh in zip(flat_t, flat_s):
+        p = _path_str(key_path)
+        if p in buffers:
+            val = buffers[p]
+        elif p in manifest["host_leaves"]:
+            val = manifest["host_leaves"][p]
+        else:
+            raise KeyError(f"checkpoint is missing leaf {p!r}")
+        if isinstance(tmpl, jax.Array) and jnp.issubdtype(
+            tmpl.dtype, jax.dtypes.prng_key
+        ):
+            val = jax.random.wrap_key_data(jnp.asarray(val))
+        restored.append(
+            jax.device_put(val, sh) if sh is not None else jnp.asarray(val)
+        )
+    state = jax.tree_util.tree_unflatten(treedef, restored)
+    logger.info(
+        "Sharded checkpoint loaded from %s, epoch %s", path, manifest["epoch"]
+    )
+    return state, int(manifest["epoch"]), dict(manifest.get("extra", {}))
+
+
+def _is_sharded(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            return f.read(len(SHARDED_MAGIC)) == SHARDED_MAGIC
+    except OSError:
+        return False
 
 
 def save_checkpoint(
@@ -124,21 +346,34 @@ def save_checkpoint(
     loss: float,
     extra: Optional[dict] = None,
     saver: Optional[AsyncSaver] = None,
+    sharded: bool = False,
 ) -> None:
-    """Write a single-logical-view checkpoint; host 0 performs the write.
+    """Write a checkpoint; see module docstring for the two formats.
 
-    With a ``saver`` (single-process only), the state is snapshotted on
-    device and the transfer/serialize/write runs in the background; without
-    one the call is fully synchronous (and collective across hosts).
+    Async (``saver``) rules: the gathered format needs a collective
+    all-gather, so it backgrounds only at process_count == 1; the sharded
+    format is collective-free and backgrounds at ANY process count.
     """
-    if saver is not None and jax.process_count() == 1:
+    write = (
+        (lambda snap: _save_sharded(path, snap, epoch, loss, extra))
+        if sharded
+        else (
+            lambda snap: _write_payload(
+                path, _gather_to_host(snap), epoch, loss, extra
+            )
+        )
+    )
+    if sharded:
+        _begin_sharded_save(path, epoch)  # main thread: cleanup + barrier
+    if saver is not None and (sharded or jax.process_count() == 1):
         # HBM-side copy: later donated train steps cannot invalidate it
         snap = jax.tree_util.tree_map(
             lambda x: x.copy() if isinstance(x, jax.Array) else x, state
         )
-        saver.submit(
-            lambda: _write_payload(path, _gather_to_host(snap), epoch, loss, extra)
-        )
+        saver.submit(lambda: write(snap))
+        return
+    if sharded:
+        _save_sharded(path, state, epoch, loss, extra)
         return
     host_state = _gather_to_host(state)
     if jax.process_index() != 0:
@@ -156,7 +391,11 @@ def load_checkpoint(
     Every process reads the same file (reference train.py:256: resume runs on
     ALL ranks before the start barrier). Device placement comes from
     ``shardings`` when given, else from the template's live shardings.
+    The format (gathered file vs sharded pointer) is auto-detected, so a
+    job can resume from either regardless of its own save format.
     """
+    if _is_sharded(path):
+        return _load_sharded(path, state_template, shardings)
     with open(path, "rb") as f:
         payload = serialization.msgpack_restore(f.read())
     state = serialization.from_state_dict(state_template, payload["state"])

@@ -57,6 +57,7 @@ class Trainer:
         metrics_file: Optional[str] = None,
         profile_dir: Optional[str] = None,
         profile_window: tuple = (10, 13),
+        checkpoint_format: str = "auto",
     ):
         self.model = model
         self.task = task
@@ -77,6 +78,20 @@ class Trainer:
         self._profiler = None  # armed in fit()
         self._saver = ckpt_lib.AsyncSaver()
         self._global_step = 0
+        if checkpoint_format not in ("auto", "gathered", "sharded"):
+            raise ValueError(
+                f"checkpoint_format must be auto|gathered|sharded, got "
+                f"{checkpoint_format!r}"
+            )
+        self._checkpoint_format = checkpoint_format
+
+    def _sharded_ckpt(self) -> bool:
+        """auto: sharded at multi-host scale (collective-free async saves,
+        no full-state gather); gathered single file otherwise (reference
+        single-file parity, train.py:185-192)."""
+        if self._checkpoint_format == "auto":
+            return jax.process_count() > 1
+        return self._checkpoint_format == "sharded"
 
     def _mesh_ctx(self):
         """Enter the partitioner's mesh so mesh-aware ops (ring attention)
@@ -283,6 +298,7 @@ class Trainer:
                         record["train_loss"],
                         extra,
                         saver=self._saver,
+                        sharded=self._sharded_ckpt(),
                     )
                 ckpt_lib.save_checkpoint(
                     os.path.join(self.checkpoint_dir, ckpt_lib.LATEST_NAME),
@@ -291,6 +307,7 @@ class Trainer:
                     record["train_loss"],
                     extra,
                     saver=self._saver,
+                    sharded=self._sharded_ckpt(),
                 )
             dist.barrier("epoch-end")
         return history, best_accuracy

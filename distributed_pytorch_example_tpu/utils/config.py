@@ -77,6 +77,12 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         "the --profile-steps window into this directory")
     parser.add_argument("--profile-steps", type=str, default="10,13",
                         help="start,stop global-step window for --profile-dir")
+    parser.add_argument("--checkpoint-format", type=str, default="auto",
+                        choices=("auto", "gathered", "sharded"),
+                        help="gathered: single all-gathered file (reference "
+                        "parity); sharded: per-process shard files, no "
+                        "gather, async at any host count; auto: sharded "
+                        "when multi-host")
     parser.add_argument("--metrics-file", type=str, default=None,
                         help="JSONL epoch-metrics path (default: "
                         "<checkpoint-dir>/metrics.jsonl)")

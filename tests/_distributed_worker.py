@@ -3,7 +3,8 @@
 Launched (not collected) by tests/test_multiprocess.py: two of these rendezvous
 via jax.distributed over localhost (the real runtime.initialize path), train a
 sharded-FSDP MLP for one epoch with cross-process batch sharding, and write a
-gathered single-logical-view checkpoint from process 0.
+sharded checkpoint (per-process shard files + process-0 manifest/pointer —
+the auto format at multi-host scale) through the async saver.
 
 Topology comes from the same env contract the launcher uses
 (NUM_PROCESSES / PROCESS_ID / COORDINATOR_ADDRESS — runtime/distributed.py).

@@ -64,10 +64,21 @@ def test_two_process_training(tmp_path):
     assert results[0]["val_loss"] == pytest.approx(results[1]["val_loss"])
     assert np.isfinite(results[0]["train_loss"])
 
-    # process 0 wrote a gathered single-logical-view checkpoint; it must
-    # restore in THIS (single-process, different-topology) interpreter
+    # at process_count > 1 the Trainer auto-selects the SHARDED format
+    # (collective-free, async-safe): the pointer file + per-process shard
+    # files must restore in THIS (single-process, different-topology)
+    # interpreter via load_checkpoint's auto-detection
     ckpt = tmp_path / "latest_model.ckpt"
     assert ckpt.exists()
+    from distributed_pytorch_example_tpu.train import checkpoint as _ck
+
+    assert _ck._is_sharded(str(ckpt)), "multi-host save should be sharded"
+    shard_dir = tmp_path / "latest_model.ckpt.shards"
+    shard_files = [
+        f for v in shard_dir.iterdir() for f in v.iterdir()
+        if f.name.startswith("shard_")
+    ]
+    assert len(shard_files) == 2, "one shard file per process"
 
     import jax
     import optax

@@ -1,0 +1,162 @@
+"""Sharded checkpoint format: no gather on save, reshard on load.
+
+The gathered format (tests/test_train.py) re-materializes the full state;
+the sharded format must (a) write only addressable replica-0 shards per
+process, (b) restore bit-identically, (c) restore under a DIFFERENT mesh
+shape than it was saved under, and (d) be auto-detected by load_checkpoint.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import distributed_pytorch_example_tpu as dpx
+from distributed_pytorch_example_tpu.models.mlp import SimpleNet
+from distributed_pytorch_example_tpu.runtime import MeshSpec, make_mesh
+from distributed_pytorch_example_tpu.train import checkpoint as ckpt_lib
+from distributed_pytorch_example_tpu.train.loop import Trainer
+from distributed_pytorch_example_tpu.train.step import init_state
+from distributed_pytorch_example_tpu.train.tasks import ClassificationTask
+
+
+def _fsdp_state(mesh):
+    model = SimpleNet()
+    x = jnp.zeros((8, 784), jnp.float32)
+    part = dpx.parallel.fsdp(mesh)
+    state, shardings = init_state(
+        model, optax.adam(1e-3), x, jax.random.key(0), part
+    )
+    return state, shardings
+
+
+def _tree_equal(a, b):
+    for x, y in zip(
+        jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    ):
+        if jnp.issubdtype(jnp.asarray(x).dtype, jax.dtypes.prng_key):
+            x, y = jax.random.key_data(x), jax.random.key_data(y)
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_sharded_roundtrip_fsdp(tmp_path, devices):
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    state, shardings = _fsdp_state(mesh)
+    path = str(tmp_path / "latest_model.ckpt")
+    ckpt_lib.save_checkpoint(path, state, 3, 0.5, {"k": 1.0}, sharded=True)
+
+    # pointer file + versioned shard dir + manifest all exist
+    assert os.path.isfile(path)
+    with open(path, "rb") as f:
+        assert f.read().startswith(ckpt_lib.SHARDED_MAGIC)
+    step_dir = os.path.join(f"{path}.shards", "00000003")
+    assert os.path.isfile(os.path.join(step_dir, "manifest.msgpack"))
+    assert os.path.isfile(os.path.join(step_dir, "shard_00000.msgpack"))
+
+    restored, epoch, extra = ckpt_lib.load_checkpoint(path, state, shardings)
+    assert epoch == 3 and extra["k"] == 1.0
+    _tree_equal(restored, state)
+    # restored leaves carry the target shardings
+    leaf = jax.tree_util.tree_leaves(restored.params)[0]
+    assert leaf.sharding == jax.tree_util.tree_leaves(shardings.params)[0]
+
+
+def test_sharded_save_writes_no_replicated_duplicates(tmp_path, devices):
+    """A replicated leaf appears exactly once in the shard files."""
+    from flax import serialization
+
+    mesh = make_mesh(MeshSpec(data=8))
+    model = SimpleNet()
+    x = jnp.zeros((8, 784), jnp.float32)
+    part = dpx.parallel.data_parallel(mesh)  # everything replicated
+    state, _ = init_state(model, optax.adam(1e-3), x, jax.random.key(0), part)
+    path = str(tmp_path / "ck")
+    ckpt_lib.save_checkpoint(path, state, 1, 0.0, sharded=True)
+    with open(
+        os.path.join(f"{path}.shards", "00000001", "shard_00000.msgpack"), "rb"
+    ) as f:
+        chunks = serialization.msgpack_restore(f.read())
+    for p, entries in chunks.items():
+        assert len(entries) == 1, f"{p} saved {len(entries)} copies"
+
+
+def test_sharded_restores_under_different_mesh(tmp_path, devices):
+    """Saved under fsdp=8, restored under data=2 x fsdp=4: same values,
+    new shardings."""
+    mesh_a = make_mesh(MeshSpec(data=1, fsdp=8))
+    state_a, _ = _fsdp_state(mesh_a)
+    path = str(tmp_path / "ck")
+    ckpt_lib.save_checkpoint(path, state_a, 2, 0.1, sharded=True)
+
+    mesh_b = make_mesh(MeshSpec(data=2, fsdp=4))
+    state_b, shardings_b = _fsdp_state(mesh_b)
+    restored, epoch, _ = ckpt_lib.load_checkpoint(path, state_b, shardings_b)
+    assert epoch == 2
+    _tree_equal(restored, state_a)
+    leaf_r = jax.tree_util.tree_leaves(restored.params)[0]
+    leaf_b = jax.tree_util.tree_leaves(state_b.params)[0]
+    assert leaf_r.sharding == leaf_b.sharding
+
+
+def test_gathered_and_sharded_interchangeable(tmp_path, devices):
+    """load_checkpoint auto-detects: a job saved sharded resumes a job
+    reading with no format hint, and vice versa."""
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    state, shardings = _fsdp_state(mesh)
+    p_gathered = str(tmp_path / "g.ckpt")
+    p_sharded = str(tmp_path / "s.ckpt")
+    ckpt_lib.save_checkpoint(p_gathered, state, 1, 0.0, sharded=False)
+    ckpt_lib.save_checkpoint(p_sharded, state, 1, 0.0, sharded=True)
+    r1, _, _ = ckpt_lib.load_checkpoint(p_gathered, state, shardings)
+    r2, _, _ = ckpt_lib.load_checkpoint(p_sharded, state, shardings)
+    _tree_equal(r1, r2)
+
+
+def test_sharded_gc_keeps_only_live_version(tmp_path, devices):
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    state, _ = _fsdp_state(mesh)
+    path = str(tmp_path / "ck")
+    ckpt_lib.save_checkpoint(path, state, 1, 0.0, sharded=True)
+    ckpt_lib.save_checkpoint(path, state, 2, 0.0, sharded=True)
+    versions = sorted(os.listdir(f"{path}.shards"))
+    assert versions == ["00000002"]
+
+
+def test_trainer_fit_resume_with_sharded_format(tmp_path, devices):
+    """End-to-end: fit with checkpoint_format='sharded', resume continues."""
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    ds = dpx.data.SyntheticClassificationDataset(num_samples=256, seed=0)
+    ckdir = str(tmp_path / "ck")
+    part = dpx.parallel.fsdp(mesh)
+
+    def trainer():
+        return Trainer(
+            SimpleNet(), ClassificationTask(), optax.adam(1e-3),
+            partitioner=part, checkpoint_dir=ckdir,
+            checkpoint_format="sharded",
+        )
+
+    loader = dpx.data.DeviceLoader(ds, 64, mesh=mesh, seed=0)
+    t1 = trainer()
+    t1.fit(loader, loader, epochs=2)
+    latest = os.path.join(ckdir, ckpt_lib.LATEST_NAME)
+    assert os.path.isfile(latest)
+    with open(latest, "rb") as f:
+        assert f.read().startswith(ckpt_lib.SHARDED_MAGIC)
+
+    t2 = trainer()
+    h2 = t2.fit(loader, loader, epochs=4, resume=latest)
+    assert [r["epoch"] for r in h2] == [2, 3]
+
+
+def test_bad_checkpoint_format_rejected(devices):
+    mesh = make_mesh(MeshSpec(data=8))
+    with pytest.raises(ValueError, match="checkpoint_format"):
+        Trainer(
+            SimpleNet(), ClassificationTask(), optax.adam(1e-3),
+            partitioner=dpx.parallel.data_parallel(mesh),
+            checkpoint_format="bogus",
+        )

@@ -217,6 +217,7 @@ def main():
         metrics_file=args.metrics_file,
         profile_dir=args.profile_dir,
         profile_window=profile_window,
+        checkpoint_format=args.checkpoint_format,
     )
     trainer.fit(
         train_loader,
