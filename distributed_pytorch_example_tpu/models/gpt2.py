@@ -34,6 +34,7 @@ class GPT2(nn.Module):
     remat: bool = False
     moe_experts: int = 0  # >0: MoE MLP on every moe_every-th block
     moe_every: int = 2
+    moe_top_k: int = 1  # experts per token (1 = Switch, 2 = GShard)
     moe_capacity_factor: float = 1.25
     pipe_axis: Optional[str] = None  # mesh axis for pipeline stages (PP)
     pipe_microbatches: int = 0  # 0 = auto
@@ -100,6 +101,7 @@ class GPT2(nn.Module):
                 remat=self.remat,
                 moe_experts=self.moe_experts,
                 moe_every=self.moe_every,
+                moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,
                 name="decoder",
             )(x, train=train)

@@ -1,21 +1,26 @@
-"""Mixture-of-Experts MLP with expert parallelism (Switch-style top-1).
+"""Mixture-of-Experts MLP with expert parallelism (Switch / GShard top-k).
 
 Beyond-reference capability (the reference is a dense MLP, SURVEY.md §2):
 scales model capacity by replacing transformer MLPs with E experts of which
-each token uses one. TPU-first design — the GShard/Switch dense-dispatch
-formulation: routing builds (tokens → expert, capacity-slot) one-hot
-dispatch/combine tensors and the whole layer is einsums, so under a mesh
-with the expert dim of the weights sharded on the ``expert`` axis XLA
+each token uses ``top_k`` (1 = Switch, 2 = GShard). TPU-first design — the
+dense-dispatch formulation: routing builds (tokens → expert, capacity-slot)
+one-hot dispatch/combine tensors and the whole layer is einsums, so under a
+mesh with the expert dim of the weights sharded on the ``expert`` axis XLA
 partitions the expert computation and inserts the token all-to-alls. No
 gather/scatter, no dynamic shapes, fully jit/remat/grad compatible.
 
-Load-balancing auxiliary loss (Switch Transformer form: E * Σ_e f_e * P_e)
-is emitted via ``self.sow("losses", ...)`` and added to the task loss by
-``train.tasks`` — models stay single-output.
+Auxiliary losses emitted via ``self.sow("losses", ...)`` and added to the
+task loss by ``train.tasks`` (models stay single-output):
 
-Capacity: each expert processes at most C = ceil(S/E * capacity_factor)
-tokens per batch row; overflow tokens pass through the residual unchanged
-(standard Switch behavior).
+- load balancing (Switch form, E * Σ_e f_e * P_e, with f_e from each
+  token's FIRST choice);
+- router z-loss (ST-MoE): mean(logsumexp(logits)^2) keeps router logits
+  from drifting to magnitudes where bf16 activations saturate.
+
+Capacity: each expert processes at most C = ceil(top_k * S / E *
+capacity_factor) tokens per batch row. First choices (across the whole
+sequence) claim slots before any second choice; overflow tokens pass
+through the residual unchanged (standard Switch/GShard behavior).
 """
 
 from __future__ import annotations
@@ -25,6 +30,7 @@ import math
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 class MoEMlpBlock(nn.Module):
@@ -33,28 +39,36 @@ class MoEMlpBlock(nn.Module):
     num_experts: int
     mlp_dim: int
     model_dim: int
+    top_k: int = 1
     capacity_factor: float = 1.25
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     aux_loss_weight: float = 0.01
+    z_loss_weight: float = 1e-3
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         batch, seq, dim = x.shape
-        n_exp = self.num_experts
-        capacity = max(1, math.ceil(seq * self.capacity_factor / n_exp))
+        n_exp, k = self.num_experts, self.top_k
+        if not 1 <= k <= n_exp:
+            raise ValueError(f"top_k {k} must be in [1, num_experts {n_exp}]")
+        capacity = max(1, math.ceil(k * seq * self.capacity_factor / n_exp))
 
         # routing in float32: small tensors, and router stability matters
         router_logits = nn.Dense(n_exp, dtype=jnp.float32, name="router")(
             x.astype(jnp.float32)
         )  # (B, S, E)
         probs = jax.nn.softmax(router_logits, axis=-1)
-        gate = jnp.max(probs, axis=-1)  # (B, S)
-        expert_idx = jnp.argmax(probs, axis=-1)  # (B, S)
+        top_probs, top_idx = lax.top_k(probs, k)  # (B, S, K)
+        if k > 1:
+            # GShard: gates renormalized over the selected experts
+            gates = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
+        else:
+            gates = top_probs  # Switch: raw router prob
 
-        # Switch load-balancing loss: E * sum_e (token fraction)*(prob mass)
-        onehot = jax.nn.one_hot(expert_idx, n_exp, dtype=jnp.float32)
-        tokens_per_expert = onehot.mean(axis=(0, 1))  # (E,)
+        onehot_k = jax.nn.one_hot(top_idx, n_exp, dtype=jnp.float32)
+        # Switch load-balancing loss, f_e from first choices only
+        tokens_per_expert = onehot_k[:, :, 0].mean(axis=(0, 1))  # (E,)
         prob_per_expert = probs.mean(axis=(0, 1))  # (E,)
         aux = n_exp * jnp.sum(tokens_per_expert * prob_per_expert)
         self.sow(
@@ -63,21 +77,37 @@ class MoEMlpBlock(nn.Module):
             reduce_fn=lambda a, b: a + b,
             init_fn=lambda: jnp.zeros((), jnp.float32),
         )
+        z = jax.nn.logsumexp(router_logits, axis=-1)  # (B, S)
+        self.sow(
+            "losses", "router_z",
+            self.z_loss_weight * jnp.mean(jnp.square(z)),
+            reduce_fn=lambda a, b: a + b,
+            init_fn=lambda: jnp.zeros((), jnp.float32),
+        )
 
-        # capacity-slot assignment: position of each token in its expert's
-        # queue along the sequence; tokens past capacity are dropped (they
-        # ride the residual connection)
-        # (cumsum - 1) only at the chosen expert's column, 0 elsewhere
-        position = (jnp.cumsum(onehot, axis=1) - 1.0) * onehot  # (B, S, E)
-        slot = jnp.sum(position, axis=-1)  # (B, S): slot in chosen expert
-        # one_hot is all-zeros for slot >= capacity, which IS the drop
-        dispatch = (
-            onehot[..., None]
+        # capacity-slot assignment: cumulative position of each (choice,
+        # token) in its expert's queue, ordered k-major so every first
+        # choice outranks every second choice; slot >= capacity one_hots to
+        # all-zeros, which IS the drop (token rides the residual)
+        oh_flat = onehot_k.transpose(0, 2, 1, 3).reshape(
+            batch, k * seq, n_exp
+        )  # (B, K*S, E), k-major priority order
+        pos = (jnp.cumsum(oh_flat, axis=1) - 1.0) * oh_flat
+        slot = (
+            jnp.sum(pos, axis=-1)
+            .reshape(batch, k, seq)
+            .transpose(0, 2, 1)
+        )  # (B, S, K)
+        dispatch_k = (
+            onehot_k[..., None]
             * jax.nn.one_hot(
                 slot.astype(jnp.int32), capacity, dtype=jnp.float32
-            )[:, :, None, :]
-        )  # (B, S, E, C) one-hot
-        combine = dispatch * gate[:, :, None, None]  # weighted return path
+            )[:, :, :, None, :]
+        )  # (B, S, K, E, C) one-hot; slots are disjoint across k
+        dispatch = jnp.sum(dispatch_k, axis=2)  # (B, S, E, C)
+        combine = jnp.sum(
+            dispatch_k * gates[..., None, None], axis=2
+        )  # weighted return path
 
         # expert weights: leading expert dim is the EP sharding target
         w_up = self.param(

@@ -152,6 +152,7 @@ class TransformerBlock(nn.Module):
     use_flash: Optional[bool] = None
     seq_axis: Optional[str] = None
     moe_experts: int = 0  # >0: Mixture-of-Experts MLP with this many experts
+    moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
 
     @nn.compact
@@ -172,6 +173,7 @@ class TransformerBlock(nn.Module):
                 num_experts=self.moe_experts,
                 mlp_dim=self.mlp_dim,
                 model_dim=self.model_dim,
+                top_k=self.moe_top_k,
                 capacity_factor=self.moe_capacity_factor,
                 dropout_rate=self.dropout_rate,
                 dtype=self.dtype,
@@ -220,6 +222,7 @@ class TransformerStack(nn.Module):
     remat: bool = False
     moe_experts: int = 0
     moe_every: int = 2  # MoE MLP on every Nth block (Switch uses 2)
+    moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
 
     @nn.compact
@@ -244,6 +247,7 @@ class TransformerStack(nn.Module):
                 use_flash=self.use_flash,
                 seq_axis=self.seq_axis,
                 moe_experts=self.moe_experts if is_moe else 0,
+                moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,
                 name=f"layer_{i}",
             )

@@ -208,7 +208,10 @@ def _save_sharded(path: str, state: Any, epoch: int, loss: float, extra) -> None
     os.makedirs(step_dir, exist_ok=True)
 
     flat, _ = jax.tree_util.tree_flatten_with_path(_raw_leaves(state))
-    chunks: dict = {}
+    # collect device handles first, then ONE batched device_get: per-shard
+    # round trips dominate on remote/tunneled device links (same rationale
+    # as _gather_to_host's batched fetch)
+    entries: list = []  # (path, starts, device_data)
     meta: dict = {}
     host_leaves: dict = {}
     for key_path, leaf in flat:
@@ -223,9 +226,13 @@ def _save_sharded(path: str, state: Any, epoch: int, loss: float, extra) -> None
             starts = [
                 int(s.start) if s.start is not None else 0 for s in shard.index
             ]
-            chunks.setdefault(p, []).append(
-                {"start": starts, "data": np.asarray(shard.data)}
-            )
+            entries.append((p, starts, shard.data))
+    fetched = jax.device_get([data for _, _, data in entries])
+    chunks: dict = {}
+    for (p, starts, _), data in zip(entries, fetched):
+        chunks.setdefault(p, []).append(
+            {"start": starts, "data": np.asarray(data)}
+        )
     _atomic_write(
         os.path.join(step_dir, f"shard_{proc:05d}.msgpack"),
         serialization.msgpack_serialize(chunks),
@@ -260,12 +267,13 @@ def _save_sharded(path: str, state: Any, epoch: int, loss: float, extra) -> None
         serialization.msgpack_serialize(manifest),
     )
     _atomic_write(path, SHARDED_MAGIC + _version(epoch).encode())
-    # GC: only the pointed-to version is live for THIS pointer; older
-    # sibling versions under this base are dead (every process's writes to
-    # them finished before this commit — per-process saves are ordered)
+    # GC: versions strictly OLDER than this commit are dead (per-process
+    # save ordering means every process finished writing them). Newer dirs
+    # may already hold in-flight shards from a save this slow process has
+    # not reached yet — zero-padded names make `<` the age comparison.
     base = f"{path}.shards"
     for name in os.listdir(base):
-        if name != _version(epoch):
+        if name < _version(epoch):
             shutil.rmtree(os.path.join(base, name), ignore_errors=True)
     logger.info(
         "Sharded checkpoint saved to %s (version %s)", path, _version(epoch)

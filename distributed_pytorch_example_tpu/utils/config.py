@@ -60,6 +60,8 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     parser.add_argument("--moe-experts", type=int, default=0,
                         help=">0: MoE MLP with this many experts on every "
                         "other transformer block (gpt2)")
+    parser.add_argument("--moe-top-k", type=int, default=1,
+                        help="experts per token (1 = Switch, 2 = GShard)")
     parser.add_argument("--partition", type=str, default="dp",
                         help="dp|fsdp|tp (tp uses per-model transformer rules)")
     parser.add_argument("--dtype", type=str, default="float32",

@@ -136,6 +136,11 @@ def main():
             parser.error(f"--moe-experts is only supported for gpt2 models, "
                          f"not {args.model!r}")
         overrides["moe_experts"] = args.moe_experts
+        if args.moe_top_k != 1:
+            overrides["moe_top_k"] = args.moe_top_k
+    if args.moe_top_k != 1 and not args.moe_experts:
+        parser.error("--moe-top-k without --moe-experts has nothing to "
+                     "route; set --moe-experts too")
     if args.mesh_expert not in (0, 1) and not args.moe_experts:
         parser.error("--mesh-expert > 1 without --moe-experts would shrink "
                      "data parallelism with nothing sharded on the expert "
