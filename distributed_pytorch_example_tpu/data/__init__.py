@@ -22,3 +22,7 @@ from distributed_pytorch_example_tpu.data.text import (  # noqa: F401
     TokenWindowDataset,
     load_token_file,
 )
+from distributed_pytorch_example_tpu.data.streaming import (  # noqa: F401
+    StreamingImageShards,
+    write_image_shards,
+)

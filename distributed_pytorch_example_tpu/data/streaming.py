@@ -1,0 +1,205 @@
+"""Streaming sharded-file image dataset: ImageNet scale without ImageNet RAM.
+
+The all-in-RAM loaders in ``data/vision.py`` cap out at datasets that fit
+in host memory; this module streams from a directory of paired numpy shard
+files instead (the memmap strategy of ``data/text.py``, applied to images):
+
+    <root>/images_00000.npy   (N, H, W, 3) uint8
+    <root>/labels_00000.npy   (N,) integer
+    <root>/images_00001.npy   ...
+
+Each shard is memory-mapped on first touch and the number of OPEN maps is
+LRU-capped (``max_open_shards``), so resident memory is bounded by
+``max_open_shards x shard_bytes + one batch`` regardless of dataset size —
+closing a map releases its pages back to the OS. Random global access (the
+exact ``DistributedSampler`` permutation contract of data/sampler.py,
+reference train.py:104-106) stays intact: ``get_batch`` groups indices by
+shard, copies the touched rows out of each map, and reassembles the batch
+in order.
+
+Labels are small (4 bytes/sample) and load fully into RAM up front.
+
+``write_image_shards`` produces the layout from any array source — used by
+tests and by offline ImageNet decode jobs (decode-to-uint8-npy once, train
+many times; the reference's decode-per-epoch ``num_workers=2`` loader,
+train.py:112, has no TPU-side analogue worth copying).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from collections import OrderedDict
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+_SHARD_RE = re.compile(r"^images_(\d+)\.npy$")
+
+
+class StreamingImageShards:
+    """Map-style dataset over ``images_*.npy``/``labels_*.npy`` shard pairs.
+
+    Exposes the same ``__len__``/``get_batch`` interface as the in-RAM
+    datasets (data/synthetic.py), so the DeviceLoader pipeline — sharded
+    sampling, wrap-padding, prefetch threads — is identical.
+
+    ``transform``: optional ``fn(batch_dict) -> batch_dict`` applied after
+    normalization (augmentation hook; runs on host in the prefetch thread).
+    """
+
+    def __init__(
+        self,
+        root: str,
+        normalize: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        transform: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]] = None,
+        max_open_shards: int = 8,
+    ):
+        if not os.path.isdir(root):
+            raise FileNotFoundError(
+                f"Shard root {root!r} does not exist. Expected "
+                "images_*.npy/labels_*.npy pairs (see "
+                "data.streaming.write_image_shards); use --dataset "
+                "synthetic-image in zero-egress environments."
+            )
+        matches = sorted(
+            ((int(m.group(1)), m.group(0))
+             for m in (_SHARD_RE.match(f) for f in os.listdir(root)) if m),
+        )
+        if not matches:
+            raise FileNotFoundError(f"No images_*.npy shards under {root!r}")
+        # the matched filename IS the path (ids are ordering keys only —
+        # zero-padding width is whatever the writer used)
+        self._image_paths = [os.path.join(root, name) for _, name in matches]
+        label_paths = [
+            os.path.join(root, name.replace("images_", "labels_", 1))
+            for _, name in matches
+        ]
+        missing = [p for p in label_paths if not os.path.exists(p)]
+        if missing:
+            raise FileNotFoundError(f"Missing label shard {missing[0]!r}")
+
+        lengths = []
+        labels = []
+        self.image_shape: Optional[Tuple[int, ...]] = None
+        for p, lp in zip(self._image_paths, label_paths):
+            shape, dtype = _npy_header(p)
+            if dtype != np.uint8:
+                raise ValueError(f"{p}: image shards must be uint8, got {dtype}")
+            if self.image_shape is None:
+                self.image_shape = tuple(shape[1:])
+            elif tuple(shape[1:]) != self.image_shape:
+                raise ValueError(
+                    f"{p}: shard image shape {shape[1:]} != first shard's "
+                    f"{self.image_shape}"
+                )
+            shard_labels = np.load(lp).astype(np.int32)
+            if len(shard_labels) != shape[0]:
+                raise ValueError(
+                    f"{lp}: {len(shard_labels)} labels != {shape[0]} image "
+                    f"rows in {p}"
+                )
+            labels.append(shard_labels)
+            lengths.append(shape[0])
+        self.labels = np.concatenate(labels)
+        self._starts = np.concatenate([[0], np.cumsum(lengths)])
+        self.num_classes = int(self.labels.max()) + 1 if len(self.labels) else 0
+        self.normalize = normalize
+        self.transform = transform
+        self.max_open_shards = max(1, max_open_shards)
+        self._open: OrderedDict[int, np.memmap] = OrderedDict()
+
+    def __len__(self) -> int:
+        return int(self._starts[-1])
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        batch = self.get_batch(np.asarray([idx]))
+        return {k: v[0] for k, v in batch.items()}
+
+    def _map(self, shard: int) -> np.memmap:
+        """LRU-capped memmap pool; closing a map frees its resident pages."""
+        if shard in self._open:
+            self._open.move_to_end(shard)
+            return self._open[shard]
+        while len(self._open) >= self.max_open_shards:
+            _, old = self._open.popitem(last=False)
+            mm = getattr(old, "_mmap", None)
+            del old
+            if mm is not None:
+                mm.close()
+        m = np.load(self._image_paths[shard], mmap_mode="r")
+        self._open[shard] = m
+        return m
+
+    def get_batch(self, indices: np.ndarray) -> Dict[str, np.ndarray]:
+        indices = np.asarray(indices)
+        shard_ids = np.searchsorted(self._starts, indices, side="right") - 1
+        x = np.empty((len(indices), *self.image_shape), np.float32)
+        # group rows by shard: one map touch per shard per batch, ascending
+        # shard order keeps the LRU pool from thrashing
+        for shard in np.unique(shard_ids):
+            sel = shard_ids == shard
+            local = indices[sel] - self._starts[shard]
+            # fancy indexing on a memmap copies the rows out — no views of
+            # the map survive, so LRU-closing it later is safe
+            x[sel] = self._map(int(shard))[local]
+        x /= 255.0
+        if self.normalize is not None:
+            mean, std = self.normalize
+            x = (x - mean) / std
+        batch = {"x": x, "y": self.labels[indices]}
+        if self.transform is not None:
+            batch = self.transform(batch)
+        return batch
+
+
+def write_image_shards(
+    root: str,
+    batches: Iterable[Tuple[np.ndarray, np.ndarray]],
+    shard_size: int = 4096,
+) -> int:
+    """Write (images uint8 NHWC, labels) batches into the shard layout.
+
+    Re-chunks arbitrary incoming batch sizes into ``shard_size``-row shards;
+    returns the number of shards written. Offline tool — decode once, train
+    many times.
+    """
+    os.makedirs(root, exist_ok=True)
+    buf_x: list = []
+    buf_y: list = []
+    buffered = 0
+    shard = 0
+
+    def flush(n: int) -> None:
+        nonlocal buf_x, buf_y, buffered, shard
+        x = np.concatenate(buf_x)
+        y = np.concatenate(buf_y)
+        np.save(os.path.join(root, f"images_{shard:05d}.npy"), x[:n])
+        np.save(os.path.join(root, f"labels_{shard:05d}.npy"), y[:n])
+        buf_x, buf_y, buffered = [x[n:]], [y[n:]], len(x) - n
+        shard += 1
+
+    for images, labels in batches:
+        images = np.asarray(images)
+        if images.dtype != np.uint8:
+            raise ValueError(f"image batches must be uint8, got {images.dtype}")
+        buf_x.append(images)
+        buf_y.append(np.asarray(labels))
+        buffered += len(images)
+        while buffered >= shard_size:
+            flush(shard_size)
+    if buffered:
+        flush(buffered)
+    return shard
+
+
+def _npy_header(path: str) -> Tuple[Tuple[int, ...], np.dtype]:
+    """(shape, dtype) from a .npy header without reading the data."""
+    arr = np.load(path, mmap_mode="r")  # lazy: maps, never touches pages
+    try:
+        return tuple(arr.shape), arr.dtype
+    finally:
+        mm = getattr(arr, "_mmap", None)
+        del arr
+        if mm is not None:
+            mm.close()

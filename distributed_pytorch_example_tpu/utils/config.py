@@ -32,7 +32,7 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         help="mlp|resnet18|resnet50|vit-b16|bert-base|gpt2")
     parser.add_argument("--dataset", type=str, default="synthetic",
                         help="synthetic|synthetic-image|synthetic-tokens|"
-                        "cifar10|tokens-file")
+                        "cifar10|image-shards|tokens-file")
     parser.add_argument("--seq-len", type=int, default=512)
     parser.add_argument("--token-dtype", type=str, default="uint16",
                         choices=("uint16", "uint32", "int32"),

@@ -1,0 +1,138 @@
+"""Streaming image shards: correctness, LRU memmap pool, bounded memory."""
+
+import os
+import resource
+
+import numpy as np
+import pytest
+
+from distributed_pytorch_example_tpu.data.streaming import (
+    StreamingImageShards,
+    write_image_shards,
+)
+
+
+def _write_dataset(root, n=256, hw=8, shard_size=64, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 256, (n, hw, hw, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, (n,)).astype(np.int64)
+    # feed in awkward batch sizes to exercise re-chunking
+    batches = [
+        (images[i : i + 37], labels[i : i + 37]) for i in range(0, n, 37)
+    ]
+    nshards = write_image_shards(root, batches, shard_size=shard_size)
+    return images, labels, nshards
+
+
+def test_writer_rechunks_and_reader_roundtrips(tmp_path):
+    root = str(tmp_path / "shards")
+    images, labels, nshards = _write_dataset(root)
+    assert nshards == 4  # 256 / 64
+    ds = StreamingImageShards(root, max_open_shards=2)
+    assert len(ds) == 256
+    assert ds.num_classes == 10
+    idx = np.asarray([0, 5, 63, 64, 200, 255, 17])  # spans all shards
+    batch = ds.get_batch(idx)
+    np.testing.assert_allclose(
+        batch["x"], images[idx].astype(np.float32) / 255.0, atol=1e-6
+    )
+    np.testing.assert_array_equal(batch["y"], labels[idx].astype(np.int32))
+    assert batch["y"].dtype == np.int32
+
+
+def test_single_item_and_normalize(tmp_path):
+    root = str(tmp_path / "s")
+    images, labels, _ = _write_dataset(root, n=64, shard_size=32)
+    mean = np.float32([0.5, 0.5, 0.5])
+    std = np.float32([0.25, 0.25, 0.25])
+    ds = StreamingImageShards(root, normalize=(mean, std))
+    item = ds[10]
+    expected = (images[10].astype(np.float32) / 255.0 - mean) / std
+    np.testing.assert_allclose(item["x"], expected, atol=1e-6)
+    assert item["y"] == labels[10]
+
+
+def test_transform_hook_applies(tmp_path):
+    root = str(tmp_path / "t")
+    _write_dataset(root, n=64, shard_size=32)
+
+    def flip_all(batch):
+        return {**batch, "x": batch["x"][:, :, ::-1]}
+
+    plain = StreamingImageShards(root)
+    flipped = StreamingImageShards(root, transform=flip_all)
+    idx = np.arange(8)
+    np.testing.assert_array_equal(
+        flipped.get_batch(idx)["x"], plain.get_batch(idx)["x"][:, :, ::-1]
+    )
+
+
+def test_lru_pool_caps_open_maps(tmp_path):
+    root = str(tmp_path / "lru")
+    _write_dataset(root, n=256, shard_size=32)  # 8 shards
+    ds = StreamingImageShards(root, max_open_shards=3)
+    ds.get_batch(np.arange(0, 256, 16))  # touches every shard
+    assert len(ds._open) <= 3
+
+
+def test_through_device_loader_matches_in_ram(tmp_path, devices):
+    """Same sampler contract through the pipeline as an in-RAM dataset."""
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+    from distributed_pytorch_example_tpu.data.synthetic import _ArrayDataset
+    from distributed_pytorch_example_tpu.runtime import make_mesh
+
+    root = str(tmp_path / "dl")
+    images, labels, _ = _write_dataset(root, n=128, shard_size=32)
+    streaming = StreamingImageShards(root)
+    in_ram = _ArrayDataset(
+        {
+            "x": images.astype(np.float32) / 255.0,
+            "y": labels.astype(np.int32),
+        }
+    )
+    mesh = make_mesh()
+    a = DeviceLoader(streaming, 16, mesh=mesh, seed=3, num_shards=1, shard_id=0)
+    b = DeviceLoader(in_ram, 16, mesh=mesh, seed=3, num_shards=1, shard_id=0)
+    a.set_epoch(1)
+    b.set_epoch(1)
+    for ba, bb in zip(a, b):
+        np.testing.assert_allclose(
+            np.asarray(ba["x"]), np.asarray(bb["x"]), atol=1e-6
+        )
+        np.testing.assert_array_equal(np.asarray(ba["y"]), np.asarray(bb["y"]))
+
+
+@pytest.mark.slow
+def test_rss_bounded_by_lru_window_not_dataset_size(tmp_path):
+    """Full random-order epoch over ~300MB of shards with a small LRU
+    window must not grow RSS by anywhere near the dataset size."""
+    root = str(tmp_path / "big")
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(0)
+    hw, per_shard, nshards = 64, 256, 100  # 256*64*64*3 = ~3MB per shard
+    for s in range(nshards):
+        np.save(
+            os.path.join(root, f"images_{s:05d}.npy"),
+            rng.integers(0, 256, (per_shard, hw, hw, 3)).astype(np.uint8),
+        )
+        np.save(
+            os.path.join(root, f"labels_{s:05d}.npy"),
+            rng.integers(0, 10, (per_shard,)).astype(np.int32),
+        )
+    total_mb = nshards * per_shard * hw * hw * 3 / 1e6
+    assert total_mb > 250
+
+    ds = StreamingImageShards(root, max_open_shards=4)
+    rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss  # KB on linux
+    order = np.random.default_rng(1).permutation(len(ds))
+    for lo in range(0, len(ds), 128):
+        ds.get_batch(order[lo : lo + 128])
+    rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    grown_mb = (rss1 - rss0) / 1024.0
+    # LRU window is 4 shards (~12MB) + batch copies; the all-in-RAM loader
+    # would need the full ~300MB (float32: 1.2GB). Generous slack for
+    # allocator noise:
+    assert grown_mb < total_mb / 3, (
+        f"RSS grew {grown_mb:.0f}MB over a {total_mb:.0f}MB dataset — "
+        "streaming is not streaming"
+    )

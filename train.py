@@ -51,6 +51,16 @@ def build_dataset(args, num_samples: int, seed: int, train: bool = True):
         from distributed_pytorch_example_tpu.data.vision import load_cifar10
 
         return load_cifar10(train=train, data_dir=args.data_dir)
+    if name == "image-shards":
+        from distributed_pytorch_example_tpu.data.streaming import (
+            StreamingImageShards,
+        )
+        from distributed_pytorch_example_tpu.data.vision import _data_root
+
+        sub = "train" if train else "val"
+        return StreamingImageShards(
+            os.path.join(_data_root(args.data_dir), "image-shards", sub)
+        )
     if name == "tokens-file":
         from distributed_pytorch_example_tpu.data.text import load_token_file
         from distributed_pytorch_example_tpu.data.vision import _data_root
@@ -67,7 +77,10 @@ def build_dataset(args, num_samples: int, seed: int, train: bool = True):
 def build_task(args, model):
     from distributed_pytorch_example_tpu import train as dpx_train
 
-    if args.dataset in ("synthetic", "synthetic-image", "cifar10", "cifar10-synthetic"):
+    if args.dataset in (
+        "synthetic", "synthetic-image", "cifar10", "cifar10-synthetic",
+        "image-shards",
+    ):
         return dpx_train.ClassificationTask()
     if args.model.startswith("bert"):
         vocab = getattr(model, "vocab_size", 30522)
@@ -110,6 +123,31 @@ def main():
         args.batch_size * dp_size,
         args.lr,
     )
+
+    # Reference semantics: --batch-size is per data-parallel replica
+    # (train.py:215 with one process per device); global batch scales with
+    # the data-parallel size.
+    global_batch = args.batch_size * dp_size
+    train_ds = build_dataset(args, args.num_samples, seed=args.seed, train=True)
+    val_ds = build_dataset(
+        args, max(args.num_samples // 10, global_batch), seed=args.seed + 1,
+        train=False,
+    )
+    # real datasets know their label space; the flag default (10) must not
+    # silently size a too-small classifier head for e.g. ImageNet shards
+    ds_classes = getattr(train_ds, "num_classes", 0)
+    if ds_classes and ds_classes != args.num_classes:
+        if args.num_classes == parser.get_default("num_classes"):
+            logger.info(
+                "Using num_classes=%d from the dataset (flag default %d)",
+                ds_classes, args.num_classes,
+            )
+            args.num_classes = ds_classes
+        elif ds_classes > args.num_classes:
+            parser.error(
+                f"--num-classes {args.num_classes} < dataset label space "
+                f"{ds_classes}"
+            )
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     overrides = {"dtype": dtype}
@@ -172,15 +210,6 @@ def main():
     else:
         partitioner = dpx.parallel.data_parallel(mesh)
 
-    # Reference semantics: --batch-size is per data-parallel replica
-    # (train.py:215 with one process per device); global batch scales with
-    # the data-parallel size.
-    global_batch = args.batch_size * dp_size
-    train_ds = build_dataset(args, args.num_samples, seed=args.seed, train=True)
-    val_ds = build_dataset(
-        args, max(args.num_samples // 10, global_batch), seed=args.seed + 1,
-        train=False,
-    )
     train_loader = dpx.data.DeviceLoader(
         train_ds, global_batch, mesh=mesh, shuffle=True, seed=args.seed
     )
