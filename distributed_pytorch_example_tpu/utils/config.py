@@ -32,7 +32,12 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         help="mlp|resnet18|resnet50|vit-b16|bert-base|gpt2")
     parser.add_argument("--dataset", type=str, default="synthetic",
                         help="synthetic|synthetic-image|synthetic-tokens|"
-                        "cifar10|image-shards|tokens-file")
+                        "cifar10|digits|image-shards|tokens-file")
+    parser.add_argument("--augment", type=str, default="none",
+                        choices=("none", "cifar", "crop", "imagenet"),
+                        help="train-time augmentation: cifar = pad-crop + "
+                        "flip, crop = pad-crop only (label-asymmetric data "
+                        "like digits), imagenet = random-resized-crop + flip")
     parser.add_argument("--seq-len", type=int, default=512)
     parser.add_argument("--token-dtype", type=str, default="uint16",
                         choices=("uint16", "uint32", "int32"),

@@ -1,0 +1,92 @@
+"""Augmentation transforms + the real-data digits dataset."""
+
+import numpy as np
+
+from distributed_pytorch_example_tpu.data.augment import (
+    AugmentedDataset,
+    pad_crop_flip,
+    random_resized_crop_flip,
+)
+from distributed_pytorch_example_tpu.data.synthetic import _ArrayDataset
+
+
+def _batch(b=8, h=16, w=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "x": rng.standard_normal((b, h, w, 3)).astype(np.float32),
+        "y": rng.integers(0, 10, (b,)).astype(np.int32),
+    }
+
+
+def test_pad_crop_flip_shapes_and_content():
+    batch = _batch()
+    out = pad_crop_flip(pad=2, seed=1)(batch)
+    assert out["x"].shape == batch["x"].shape
+    np.testing.assert_array_equal(out["y"], batch["y"])
+    # crops come from the padded canvas: every output pixel is either 0
+    # (padding) or present in the source image
+    assert not np.array_equal(out["x"], batch["x"])  # something moved
+
+
+def test_pad_crop_zero_offset_recovers_identity():
+    batch = _batch()
+    # pad=0: crop is the whole image; flip disabled -> exact identity
+    out = pad_crop_flip(pad=0, flip=False)(batch)
+    np.testing.assert_array_equal(out["x"], batch["x"])
+
+
+def test_flip_only_mirrors_some_rows():
+    batch = _batch(b=64)
+    out = pad_crop_flip(pad=0, flip=True, seed=3)(batch)
+    mirrored = np.array([
+        np.array_equal(out["x"][i], batch["x"][i, :, ::-1])
+        for i in range(64)
+    ])
+    identical = np.array([
+        np.array_equal(out["x"][i], batch["x"][i]) for i in range(64)
+    ])
+    assert (mirrored | identical).all()
+    assert mirrored.any() and identical.any()
+
+
+def test_random_resized_crop_output_size():
+    batch = _batch(h=32, w=32)
+    out = random_resized_crop_flip(size=24, seed=2)(batch)
+    assert out["x"].shape == (8, 24, 24, 3)
+    assert np.isfinite(out["x"]).all()
+
+
+def test_augmented_dataset_through_loader(devices):
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+    from distributed_pytorch_example_tpu.runtime import make_mesh
+
+    rng = np.random.default_rng(0)
+    ds = _ArrayDataset(
+        {
+            "x": rng.standard_normal((64, 16, 16, 3)).astype(np.float32),
+            "y": rng.integers(0, 10, (64,)).astype(np.int32),
+        }
+    )
+    aug = AugmentedDataset(ds, pad_crop_flip(pad=2, seed=0))
+    loader = DeviceLoader(
+        aug, 16, mesh=make_mesh(), num_shards=1, shard_id=0
+    )
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0]["x"].shape == (16, 16, 16, 3)
+
+
+def test_digits_dataset_real_data():
+    from distributed_pytorch_example_tpu.data.vision import load_digits
+
+    train = load_digits(train=True)
+    val = load_digits(train=False)
+    assert len(train) + len(val) == 1797  # the full UCI optical-digits set
+    assert train.num_classes == 10
+    item = train[0]
+    assert item["x"].shape == (32, 32, 3)  # 8x8 upscaled 4x, 3-channel
+    # splits are disjoint and deterministic
+    train2 = load_digits(train=True)
+    np.testing.assert_array_equal(
+        train.get_batch(np.arange(4))["y"], train2.get_batch(np.arange(4))["y"]
+    )

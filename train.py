@@ -51,6 +51,10 @@ def build_dataset(args, num_samples: int, seed: int, train: bool = True):
         from distributed_pytorch_example_tpu.data.vision import load_cifar10
 
         return load_cifar10(train=train, data_dir=args.data_dir)
+    if name == "digits":
+        from distributed_pytorch_example_tpu.data.vision import load_digits
+
+        return load_digits(train=train)
     if name == "image-shards":
         from distributed_pytorch_example_tpu.data.streaming import (
             StreamingImageShards,
@@ -79,7 +83,7 @@ def build_task(args, model):
 
     if args.dataset in (
         "synthetic", "synthetic-image", "cifar10", "cifar10-synthetic",
-        "image-shards",
+        "image-shards", "digits",
     ):
         return dpx_train.ClassificationTask()
     if args.model.startswith("bert"):
@@ -133,6 +137,25 @@ def main():
         args, max(args.num_samples // 10, global_batch), seed=args.seed + 1,
         train=False,
     )
+    if args.augment != "none":
+        if args.dataset in ("synthetic", "synthetic-tokens", "tokens-file"):
+            parser.error(f"--augment only applies to image datasets, not "
+                         f"{args.dataset!r}")
+        from distributed_pytorch_example_tpu.data.augment import (
+            AugmentedDataset,
+            pad_crop_flip,
+            random_resized_crop_flip,
+        )
+
+        if args.augment == "imagenet":
+            transform = random_resized_crop_flip(
+                size=args.image_size, seed=args.seed
+            )
+        else:
+            transform = pad_crop_flip(
+                flip=args.augment == "cifar", seed=args.seed
+            )
+        train_ds = AugmentedDataset(train_ds, transform)  # train only
     # real datasets know their label space; the flag default (10) must not
     # silently size a too-small classifier head for e.g. ImageNet shards
     ds_classes = getattr(train_ds, "num_classes", 0)
