@@ -105,6 +105,12 @@ def run_model(name: str, args) -> dict:
             overrides["use_flash"] = args.flash == "on"
         model = dpx.models.get_model(name, **overrides)
         seq_len = min(args.seq_len, model.max_len)  # BERT caps at 512
+        if seq_len != args.seq_len:
+            print(
+                f"bench: clamping seq-len {args.seq_len} -> {seq_len} "
+                f"({name} max_len)",
+                file=sys.stderr,
+            )
         if name.startswith("bert"):
             task = dpx.train.MLMTask(
                 vocab_size=model.vocab_size, mask_token_id=103
@@ -173,9 +179,12 @@ def run_model(name: str, args) -> dict:
     peak = _peak_flops(jax.devices()[0])
     if flops_per_step is not None and peak is not None:
         # cost_analysis is of the per-device partitioned executable, so
-        # this is already per-chip utilization — no n_chips division
+        # this is already per-chip utilization — no n_chips division.
+        # Under --remat the executable's FLOPs include recomputation, so
+        # the honest name is HFU (hardware), not MFU (model).
         steps_per_sec = args.steps / elapsed
-        result["mfu"] = round(flops_per_step * steps_per_sec / peak, 4)
+        util = round(flops_per_step * steps_per_sec / peak, 4)
+        result["hfu" if args.remat else "mfu"] = util
         result["flops_per_step_per_chip"] = flops_per_step
     print(
         f"bench: {name}: {elapsed:.2f}s for {args.steps} steps "

@@ -46,4 +46,8 @@ def get_model(name: str, **overrides: Any):
         from distributed_pytorch_example_tpu.models.gpt2 import GPT2
 
         return GPT2(**overrides)
+    if name in ("llama", "llama-tiny"):
+        from distributed_pytorch_example_tpu.models.llama import Llama
+
+        return Llama(**overrides)
     raise ValueError(f"Unknown model: {name!r}")

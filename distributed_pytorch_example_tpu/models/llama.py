@@ -1,0 +1,164 @@
+"""LLaMA-style decoder LM: RMSNorm + RoPE + SwiGLU + grouped-query attention.
+
+Beyond-reference model family (the reference's only model is a 3-layer MLP,
+reference train.py:32-50): the architecture every current open-weights LM
+uses, demonstrating the framework generalizes past the GPT-2/BERT classics:
+
+- pre-norm **RMSNorm** (no centering, float32 statistics);
+- **RoPE** rotary positions on q/k (ops/rope.py) — applied before the
+  attention dispatch, so the Pallas flash kernel serves RoPE models
+  unchanged;
+- **GQA**: ``num_kv_heads < num_heads`` shrinks the KV projections (and
+  any future KV cache) by the group factor; the flash kernel routes
+  q-head blocks to their kv head via the BlockSpec index map;
+- **SwiGLU** MLP (silu(gate) * up -> down), param paths ``mlp/gate|up|down``
+  matching the Megatron column/row partition rules;
+- untied LM head.
+
+The default config is a ~110M toy ("llama-tiny") so the zoo entry trains
+on one chip; override fields for real sizes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_pytorch_example_tpu.models.transformer import (
+    MultiHeadAttention,
+)
+
+
+class RMSNorm(nn.Module):
+    epsilon: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jnp.reciprocal(jnp.sqrt(var + self.epsilon))
+        return (y * scale.astype(jnp.float32)).astype(self.dtype)
+
+
+class SwiGluMlp(nn.Module):
+    mlp_dim: int
+    model_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        gate = nn.Dense(
+            self.mlp_dim, use_bias=False, dtype=self.dtype, name="gate"
+        )(x)
+        up = nn.Dense(
+            self.mlp_dim, use_bias=False, dtype=self.dtype, name="up"
+        )(x)
+        h = nn.silu(gate) * up
+        return nn.Dense(
+            self.model_dim, use_bias=False, dtype=self.dtype, name="down"
+        )(h)
+
+
+class LlamaBlock(nn.Module):
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    model_dim: int
+    mlp_dim: int
+    rope_theta: float = 10000.0
+    layer_norm_epsilon: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        attn = MultiHeadAttention(
+            num_heads=self.num_heads,
+            head_dim=self.head_dim,
+            model_dim=self.model_dim,
+            causal=True,
+            dtype=self.dtype,
+            use_flash=self.use_flash,
+            seq_axis=self.seq_axis,
+            num_kv_heads=self.num_kv_heads,
+            rope=True,
+            rope_theta=self.rope_theta,
+            name="attn",
+        )
+        mlp = SwiGluMlp(
+            mlp_dim=self.mlp_dim, model_dim=self.model_dim, dtype=self.dtype,
+            name="mlp",
+        )
+        ln1 = RMSNorm(self.layer_norm_epsilon, self.dtype, name="ln1")
+        ln2 = RMSNorm(self.layer_norm_epsilon, self.dtype, name="ln2")
+        x = x + attn(ln1(x), train=train)
+        return x + mlp(ln2(x))
+
+
+class Llama(nn.Module):
+    """LLaMA-style decoder; defaults are a ~110M single-chip config."""
+
+    vocab_size: int = 32000
+    max_len: int = 2048
+    model_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: int = 4
+    mlp_dim: int = 2048
+    rope_theta: float = 10000.0
+    dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None
+    seq_axis: Optional[str] = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False):
+        # tokens: (B, S) int32 → logits (B, S, vocab); positions come from
+        # RoPE inside attention — no learned position table
+        x = nn.Embed(
+            self.vocab_size,
+            self.model_dim,
+            embedding_init=nn.initializers.normal(stddev=0.02),
+            name="tok_embed",
+        )(tokens).astype(self.dtype)
+
+        for i in range(self.num_layers):
+            block = LlamaBlock(
+                num_heads=self.num_heads,
+                num_kv_heads=self.num_kv_heads,
+                head_dim=self.model_dim // self.num_heads,
+                model_dim=self.model_dim,
+                mlp_dim=self.mlp_dim,
+                rope_theta=self.rope_theta,
+                dtype=self.dtype,
+                use_flash=self.use_flash,
+                seq_axis=self.seq_axis,
+                name=f"layer_{i}",
+            )
+            if self.remat:
+                x = nn.remat(
+                    lambda mdl, h: LlamaBlock.__call__(mdl, h, train=train),
+                    prevent_cse=False,
+                )(block, x)
+            else:
+                x = block(x, train=train)
+        x = RMSNorm(1e-5, self.dtype, name="final_ln")(x)
+        # untied head; bf16 operands with float32 accumulation — same
+        # stable-softmax convention as tied_head_logits (transformer.py)
+        head = self.param(
+            "lm_head",
+            nn.initializers.normal(stddev=0.02),
+            (self.model_dim, self.vocab_size),
+        )
+        import jax
+
+        return jax.lax.dot_general(
+            x, head.astype(self.dtype),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )

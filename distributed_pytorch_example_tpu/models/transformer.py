@@ -60,17 +60,43 @@ class MultiHeadAttention(nn.Module):
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None  # None = auto-select
     seq_axis: Optional[str] = None  # mesh axis for ring attention
+    num_kv_heads: Optional[int] = None  # < num_heads = GQA (None = MHA)
+    rope: bool = False  # rotary embeddings on q/k (LLaMA-style)
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, x, mask=None, *, kv_mask=None, train: bool = False):
+        kv_heads = self.num_kv_heads or self.num_heads
+        if self.num_heads % kv_heads:
+            raise ValueError(
+                f"num_heads {self.num_heads} not divisible by num_kv_heads "
+                f"{kv_heads}"
+            )
         features = self.num_heads * self.head_dim
+        kv_features = kv_heads * self.head_dim
         q = nn.Dense(features, dtype=self.dtype, name="q")(x)
-        k = nn.Dense(features, dtype=self.dtype, name="k")(x)
-        v = nn.Dense(features, dtype=self.dtype, name="v")(x)
+        k = nn.Dense(kv_features, dtype=self.dtype, name="k")(x)
+        v = nn.Dense(kv_features, dtype=self.dtype, name="v")(x)
         batch, seq = x.shape[0], x.shape[1]
-        shape = (batch, seq, self.num_heads, self.head_dim)
-        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        q = q.reshape(batch, seq, self.num_heads, self.head_dim)
+        k = k.reshape(batch, seq, kv_heads, self.head_dim)
+        v = v.reshape(batch, seq, kv_heads, self.head_dim)
+        if self.rope:
+            from distributed_pytorch_example_tpu.ops.rope import rope
+
+            q = rope(q, theta=self.rope_theta)
+            k = rope(k, theta=self.rope_theta)
         ring_mesh = self._ring_mesh(mask if mask is not None else kv_mask)
+        if ring_mesh is not None and kv_heads != self.num_heads:
+            raise NotImplementedError(
+                "GQA is not supported on the ring-attention path yet "
+                "(kv heads shard differently from q heads)"
+            )
+        if ring_mesh is not None and self.rope:
+            raise NotImplementedError(
+                "RoPE under sequence parallelism needs global positions "
+                "threaded to the shards; not wired yet"
+            )
         if ring_mesh is not None:
             from distributed_pytorch_example_tpu.ops.ring_attention import (
                 ring_attention_sharded,

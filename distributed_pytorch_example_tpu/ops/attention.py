@@ -28,7 +28,12 @@ def _xla_attention(
     causal: bool,
     softmax_scale: float,
 ) -> jax.Array:
-    """Reference attention in pure XLA ops. q,k,v: (B, S, N, H)."""
+    """Reference attention in pure XLA ops. q: (B, S, N, H); k/v may have
+    fewer heads (GQA) as long as N divides by them."""
+    if k.shape[2] != q.shape[2]:  # GQA: broadcast kv heads across groups
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     logits = jnp.einsum("bqnh,bknh->bnqk", q, k) * softmax_scale
     # Upcast the softmax: bf16 logits lose too much precision in the reduce.
     logits = logits.astype(jnp.float32)
@@ -114,13 +119,17 @@ def _flash_unsupported_reason(q, k, v, mask, causal) -> Optional[str]:
     """None if the flash kernel can serve this call, else a human reason."""
     if mask is not None:
         return "custom masks are not implemented in the flash kernel"
-    if not _on_tpu():
-        return "flash kernel is TPU-only"
     seq_q, seq_k, head_dim = q.shape[1], k.shape[1], q.shape[-1]
     if causal and seq_q != seq_k:
         # flash causal masking is top-left (row >= col) aligned; the XLA
         # reference is bottom-right aligned — they only agree for seq_q==seq_k
         return f"causal with seq_q != seq_k ({seq_q} != {seq_k})"
+    if q.shape[2] % k.shape[2]:
+        return (
+            f"q heads {q.shape[2]} not a multiple of kv heads {k.shape[2]}"
+        )
+    if not _on_tpu():
+        return "flash kernel is TPU-only"
     if seq_q % 128 or seq_k % 128:
         return f"seq lengths ({seq_q}, {seq_k}) not multiples of 128"
     if head_dim not in (64, 128, 256):

@@ -127,13 +127,18 @@ def _fwd_kernel(
 
 
 def _fwd(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret):
-    # q, k, v: (B, N, S, H); kv_mask: (B, S_k) float 0/1 or None
+    # q: (B, N, S, H); k, v: (B, K, S_k, H) with N % K == 0 (GQA: the kv
+    # index maps route q-head n to kv-head n // group); kv_mask: (B, S_k)
+    # float 0/1 or None
     batch, heads, seq_q, head_dim = q.shape
     seq_k = k.shape[2]
+    group = heads // k.shape[1]
     grid = (batch, heads, seq_q // block_q, seq_k // block_k)
 
     qspec = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i, j: (b, n, i, 0))
-    kspec = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, n, i, j: (b, n, j, 0))
+    kspec = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, n, i, j: (b, n // group, j, 0)
+    )
     has_mask = kv_mask is not None
     in_specs = [qspec, kspec, kspec]
     inputs = [q, k, v]
@@ -302,6 +307,7 @@ def _bwd(q, k, v, o, lse, do, kv_mask, causal, scale, block_q, block_k,
          interpret, delta=None):
     batch, heads, seq_q, head_dim = q.shape
     seq_k = k.shape[2]
+    group = heads // k.shape[1]
     if delta is None:
         delta = jnp.sum(
             do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
@@ -312,7 +318,9 @@ def _bwd(q, k, v, o, lse, do, kv_mask, causal, scale, block_q, block_k,
     has_mask = kv_mask is not None
 
     qspec = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i, j: (b, n, i, 0))
-    kspec = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, n, i, j: (b, n, j, 0))
+    kspec = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, n, i, j: (b, n // group, j, 0)
+    )
     rowspec = pl.BlockSpec((1, 1, block_q, 1), lambda b, n, i, j: (b, n, i, 0))
 
     in_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
@@ -335,7 +343,14 @@ def _bwd(q, k, v, o, lse, do, kv_mask, causal, scale, block_q, block_k,
 
     # k-block-major grid: q streams innermost
     qspec_t = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, j, i: (b, n, i, 0))
-    kspec_t = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, n, j, i: (b, n, j, 0))
+    kspec_t = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, n, j, i: (b, n // group, j, 0)
+    )
+    # dK/dV accumulate PER Q-HEAD (kv blocks are read via the group map,
+    # but writes must not race across a group) and are group-summed below
+    kspec_out = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, n, j, i: (b, n, j, 0)
+    )
     rowspec_t = pl.BlockSpec((1, 1, block_q, 1), lambda b, n, j, i: (b, n, i, 0))
     in_specs_t = [qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t, rowspec_t]
     inputs_t = [q, k, v, do, lse, delta]
@@ -349,14 +364,17 @@ def _bwd(q, k, v, o, lse, do, kv_mask, causal, scale, block_q, block_k,
         ),
         grid=(batch, heads, seq_k // block_k, seq_q // block_q),
         in_specs=in_specs_t,
-        out_specs=[kspec_t, kspec_t],
+        out_specs=[kspec_out, kspec_out],
         out_shape=[
-            _sds(k.shape, k.dtype, q),
-            _sds(v.shape, v.dtype, q),
+            _sds((batch, heads, seq_k, head_dim), k.dtype, q),
+            _sds((batch, heads, seq_k, head_dim), v.dtype, q),
         ],
         scratch_shapes=[_vmem((block_k, head_dim)), _vmem((block_k, head_dim))],
         interpret=interpret,
     )(*inputs_t)
+    if group > 1:  # GQA: fold the per-q-head contributions into kv heads
+        dk = dk.reshape(batch, k.shape[1], group, seq_k, head_dim).sum(2)
+        dv = dv.reshape(batch, v.shape[1], group, seq_k, head_dim).sum(2)
     return dq, dk, dv
 
 

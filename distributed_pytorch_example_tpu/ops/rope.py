@@ -1,0 +1,47 @@
+"""Rotary position embeddings (RoPE), rotate-half formulation.
+
+Position information injected by rotating each (q, k) head-dim pair by a
+position-dependent angle — no learned position table, exact relative
+offsets, and lengths extrapolate beyond training. Applied to q/k BEFORE
+the attention dispatch, so every kernel path (XLA, Pallas flash, ring)
+gets RoPE for free; under sequence parallelism the caller passes the
+shard's global ``positions`` so rotations stay globally consistent.
+
+The rotate-half (GPT-NeoX / LLaMA) convention: the head dim is split in
+halves (x1, x2) and rotated as (x1·cos − x2·sin, x2·cos + x1·sin) with
+frequencies theta^(−2i/d).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def rope(
+    x: jax.Array,
+    positions: Optional[jax.Array] = None,
+    theta: float = 10000.0,
+) -> jax.Array:
+    """Rotate (B, S, N, H) queries or keys by their positions.
+
+    ``positions``: (S,) int32 global positions; default arange(S). Angles
+    are computed in float32 regardless of the compute dtype.
+    """
+    head_dim = x.shape[-1]
+    if head_dim % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
+    half = head_dim // 2
+    if positions is None:
+        positions = jnp.arange(x.shape[1])
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs  # (S, half)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return rotated.astype(x.dtype)

@@ -35,8 +35,8 @@ TRANSFORMER_TP_RULES: tuple = (
     # column-parallel: shard output dim
     (r"attn/(q|k|v)/kernel$", P(None, "tensor")),
     (r"attn/(q|k|v)/bias$", P("tensor")),
-    (r"mlp/up/kernel$", P(None, "tensor")),
-    (r"mlp/up/bias$", P("tensor")),
+    (r"mlp/(up|gate)/kernel$", P(None, "tensor")),
+    (r"mlp/(up|gate)/bias$", P("tensor")),
     # row-parallel: shard input dim, replicate bias
     (r"attn/o/kernel$", P("tensor", None)),
     (r"mlp/down/kernel$", P("tensor", None)),

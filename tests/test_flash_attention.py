@@ -177,3 +177,44 @@ def test_fully_padded_rows_zero_on_xla_path_too():
     np.testing.assert_allclose(
         np.asarray(flash), np.asarray(xla), atol=2e-5
     )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gqa_forward_and_grads_match_xla(causal):
+    """Grouped-query attention: 4 q-heads sharing 2 kv-heads."""
+    rng = np.random.default_rng(12)
+    q = jnp.asarray(rng.standard_normal((2, 256, 4, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 256, 2, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 256, 2, 64)), jnp.float32)
+    scale = 64 ** -0.5
+
+    expected = _xla_attention(q, k, v, None, None, causal, scale)
+    got = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, None, None, causal, scale) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, interpret=True) ** 2
+        )
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ref, g_flash, "qkv"):
+        assert gf.shape == gr.shape
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+def test_gqa_indivisible_heads_not_selected():
+    from distributed_pytorch_example_tpu.ops.attention import (
+        _flash_unsupported_reason,
+    )
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, 128, 6, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 128, 4, 64)), jnp.float32)
+    assert "heads" in _flash_unsupported_reason(q, k, k, None, False)

@@ -43,7 +43,12 @@ def build_dataset(args, num_samples: int, seed: int, train: bool = True):
             seed=seed,
         )
     if name == "synthetic-tokens":
-        vocab = 50257 if args.model.startswith("gpt") else 30522
+        if args.model.startswith("gpt"):
+            vocab = 50257
+        elif args.model.startswith("llama"):
+            vocab = 32000
+        else:
+            vocab = 30522
         return dpx_data.SyntheticTokenDataset(
             num_samples=num_samples, seq_len=args.seq_len, vocab_size=vocab, seed=seed
         )
@@ -176,7 +181,7 @@ def main():
     overrides = {"dtype": dtype}
     if args.model in ("mlp",) or args.model.startswith("resnet") or args.model.startswith("vit"):
         overrides["num_classes"] = args.num_classes
-    if args.model.startswith(("vit", "bert", "gpt")):
+    if args.model.startswith(("vit", "bert", "gpt", "llama")):
         if args.remat:
             overrides["remat"] = True
         if args.flash != "auto":
