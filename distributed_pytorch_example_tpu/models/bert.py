@@ -28,7 +28,8 @@ class BertBase(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None
-    seq_axis: Optional[str] = None  # mesh axis for ring attention (SP)
+    seq_axis: Optional[str] = None  # mesh axis for sequence parallelism
+    sp_mode: str = "ring"  # "ring" | "ulysses"
     remat: bool = False
     # real (padded) corpora: keys at pad positions are masked out of every
     # attention — flash keeps its fast path (kv_mask streams through the
@@ -76,6 +77,7 @@ class BertBase(nn.Module):
             dtype=self.dtype,
             use_flash=self.use_flash,
             seq_axis=self.seq_axis,
+            sp_mode=self.sp_mode,
             remat=self.remat,
             name="encoder",
         )(x, kv_mask=kv_mask, train=train)

@@ -30,7 +30,8 @@ class GPT2(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None
-    seq_axis: Optional[str] = None  # mesh axis for ring attention (SP)
+    seq_axis: Optional[str] = None  # mesh axis for sequence parallelism
+    sp_mode: str = "ring"  # "ring" | "ulysses"
     remat: bool = False
     moe_experts: int = 0  # >0: MoE MLP on every moe_every-th block
     moe_every: int = 2
@@ -98,6 +99,7 @@ class GPT2(nn.Module):
                 dtype=self.dtype,
                 use_flash=self.use_flash,
                 seq_axis=self.seq_axis,
+                sp_mode=self.sp_mode,
                 remat=self.remat,
                 moe_experts=self.moe_experts,
                 moe_every=self.moe_every,

@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from distributed_pytorch_example_tpu.models.transformer import (
@@ -74,6 +75,7 @@ class LlamaBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None
     seq_axis: Optional[str] = None
+    sp_mode: str = "ulysses"  # GQA needs the all-to-all SP path
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -88,6 +90,7 @@ class LlamaBlock(nn.Module):
             num_kv_heads=self.num_kv_heads,
             rope=True,
             rope_theta=self.rope_theta,
+            sp_mode=self.sp_mode,
             name="attn",
         )
         mlp = SwiGluMlp(
@@ -114,6 +117,7 @@ class Llama(nn.Module):
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None
     seq_axis: Optional[str] = None
+    sp_mode: str = "ulysses"
     remat: bool = False
 
     @nn.compact
@@ -138,6 +142,7 @@ class Llama(nn.Module):
                 dtype=self.dtype,
                 use_flash=self.use_flash,
                 seq_axis=self.seq_axis,
+                sp_mode=self.sp_mode,
                 name=f"layer_{i}",
             )
             if self.remat:
@@ -155,8 +160,6 @@ class Llama(nn.Module):
             nn.initializers.normal(stddev=0.02),
             (self.model_dim, self.vocab_size),
         )
-        import jax
-
         return jax.lax.dot_general(
             x, head.astype(self.dtype),
             (((x.ndim - 1,), (0,)), ((), ())),

@@ -63,6 +63,7 @@ class MultiHeadAttention(nn.Module):
     num_kv_heads: Optional[int] = None  # < num_heads = GQA (None = MHA)
     rope: bool = False  # rotary embeddings on q/k (LLaMA-style)
     rope_theta: float = 10000.0
+    sp_mode: str = "ring"  # sequence parallelism: "ring" | "ulysses"
 
     @nn.compact
     def __call__(self, x, mask=None, *, kv_mask=None, train: bool = False):
@@ -86,18 +87,25 @@ class MultiHeadAttention(nn.Module):
 
             q = rope(q, theta=self.rope_theta)
             k = rope(k, theta=self.rope_theta)
+        # NB: RoPE above runs on the GLOBAL (pre-shard_map) arrays, so
+        # positions are globally correct under either SP mode.
         ring_mesh = self._ring_mesh(mask if mask is not None else kv_mask)
-        if ring_mesh is not None and kv_heads != self.num_heads:
-            raise NotImplementedError(
-                "GQA is not supported on the ring-attention path yet "
-                "(kv heads shard differently from q heads)"
+        if ring_mesh is not None and self.sp_mode == "ulysses":
+            from distributed_pytorch_example_tpu.ops.ulysses import (
+                ulysses_attention_sharded,
             )
-        if ring_mesh is not None and self.rope:
-            raise NotImplementedError(
-                "RoPE under sequence parallelism needs global positions "
-                "threaded to the shards; not wired yet"
+
+            out = ulysses_attention_sharded(
+                q, k, v, ring_mesh, seq_axis=self.seq_axis,
+                causal=self.causal, use_flash=self.use_flash,
             )
-        if ring_mesh is not None:
+        elif ring_mesh is not None:
+            if kv_heads != self.num_heads:
+                raise NotImplementedError(
+                    "GQA is not supported on the ring-attention path "
+                    "(kv heads break the ring's equal-head einsums); use "
+                    "sp_mode='ulysses'"
+                )
             from distributed_pytorch_example_tpu.ops.ring_attention import (
                 ring_attention_sharded,
             )
@@ -177,6 +185,7 @@ class TransformerBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None
     seq_axis: Optional[str] = None
+    sp_mode: str = "ring"
     moe_experts: int = 0  # >0: Mixture-of-Experts MLP with this many experts
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
@@ -192,6 +201,7 @@ class TransformerBlock(nn.Module):
             dtype=self.dtype,
             use_flash=self.use_flash,
             seq_axis=self.seq_axis,
+            sp_mode=self.sp_mode,
             name="attn",
         )
         if self.moe_experts:
@@ -245,6 +255,7 @@ class TransformerStack(nn.Module):
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None
     seq_axis: Optional[str] = None
+    sp_mode: str = "ring"
     remat: bool = False
     moe_experts: int = 0
     moe_every: int = 2  # MoE MLP on every Nth block (Switch uses 2)
@@ -272,6 +283,7 @@ class TransformerStack(nn.Module):
                 dtype=self.dtype,
                 use_flash=self.use_flash,
                 seq_axis=self.seq_axis,
+                sp_mode=self.sp_mode,
                 moe_experts=self.moe_experts if is_moe else 0,
                 moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,

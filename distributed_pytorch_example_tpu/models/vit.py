@@ -26,7 +26,8 @@ class VisionTransformer(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None
-    seq_axis: Optional[str] = None  # mesh axis for ring attention (SP)
+    seq_axis: Optional[str] = None  # mesh axis for sequence parallelism
+    sp_mode: str = "ring"  # "ring" | "ulysses"
     remat: bool = False
 
     @nn.compact
@@ -67,6 +68,7 @@ class VisionTransformer(nn.Module):
             dtype=self.dtype,
             use_flash=self.use_flash,
             seq_axis=self.seq_axis,
+            sp_mode=self.sp_mode,
             remat=self.remat,
             name="encoder",
         )(x, train=train)

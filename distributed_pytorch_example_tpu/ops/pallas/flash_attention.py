@@ -435,6 +435,13 @@ def flash_attention(
     """
     if softmax_scale is None:
         softmax_scale = q.shape[-1] ** -0.5
+    if q.shape[2] % k.shape[2]:
+        # an indivisible group would make the kv BlockSpec index maps read
+        # out-of-range head blocks (clamped, silently wrong) — refuse
+        raise ValueError(
+            f"q heads ({q.shape[2]}) must be a multiple of kv heads "
+            f"({k.shape[2]}) for GQA"
+        )
     seq_q, seq_k = q.shape[1], k.shape[1]
     block_q = _fit_block(seq_q, block_q)
     block_k = _fit_block(seq_k, block_k)

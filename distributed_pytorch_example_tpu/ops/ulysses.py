@@ -1,0 +1,128 @@
+"""Ulysses-style sequence parallelism: all-to-all heads <-> sequence swap.
+
+The second sequence-parallel mode next to ring attention (ops/
+ring_attention.py), covering the other side of the long-context design
+space (DeepSpeed-Ulysses): instead of rotating K/V chunks around a ring,
+ONE all-to-all per projection re-shards (batch, seq/P, heads, dim) into
+(batch, seq, heads/P, dim) — each device then runs ordinary FULL-sequence
+attention for its group of heads (the Pallas flash kernel, causal masking,
+everything — no cross-chunk online-softmax bookkeeping), and a second
+all-to-all restores the sequence sharding.
+
+Trade-offs vs ring:
+
+- communication: 2 all-to-alls of the qkv/out tensors vs (P-1) K/V
+  neighbor transfers — all-to-all rides ICI efficiently and the volume is
+  independent of P;
+- memory: full-sequence activations for heads/P heads per device (ring
+  keeps O(S_local) always) — Ulysses scales sequence length only until
+  S x N/P activations fit;
+- constraint: the head count must divide by the axis size (ring has no
+  such constraint).
+
+Both compose with the same mesh axes; ``MultiHeadAttention`` selects via
+``sp_mode``. The all-to-alls are reverse-mode differentiable (their
+transpose is the inverse all-to-all), so no custom VJP is needed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_pytorch_example_tpu.ops.attention import dot_product_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
+) -> jax.Array:
+    """All-to-all attention; call inside ``shard_map``.
+
+    Args:
+      q, k, v: local shards (batch, seq_local, heads, head_dim), sharded on
+        the sequence dim over ``axis_name``. ``heads`` must divide by the
+        axis size.
+
+    Returns the local output shard (batch, seq_local, heads, head_dim).
+    """
+    import jax.numpy as jnp
+
+    p = lax.axis_size(axis_name)
+    if q.shape[2] % p:
+        raise ValueError(
+            f"ulysses needs q heads ({q.shape[2]}) divisible by the "
+            f"sequence axis size ({p}); use ring attention otherwise"
+        )
+    kv_heads = k.shape[2]
+    if kv_heads % p:
+        if p % kv_heads:
+            raise ValueError(
+                f"ulysses needs kv heads ({kv_heads}) to divide or be "
+                f"divided by the sequence axis size ({p})"
+            )
+        # GQA with fewer kv heads than devices: replicate kv heads up to
+        # the axis size (each q-head group still sees its correct kv head
+        # — the group mapping is preserved under the replication)
+        rep = p // kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    def to_heads(x):
+        # (B, S/P, N, H) -> (B, S, N/P, H): split the head dim across the
+        # axis, gather the full sequence
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def to_seq(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    out = dot_product_attention(
+        to_heads(q), to_heads(k), to_heads(v),
+        causal=causal, softmax_scale=softmax_scale, use_flash=use_flash,
+    )
+    return to_seq(out)
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "sequence",
+    batch_axes: Sequence[str] = ("data", "fsdp"),
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
+) -> jax.Array:
+    """Ulysses attention on global (B, S, N, H) arrays: shard, swap, attend,
+    swap back. jit composes these specs with the surrounding program."""
+    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    spec = P(batch, seq_axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            ulysses_attention,
+            axis_name=seq_axis,
+            causal=causal,
+            softmax_scale=softmax_scale,
+            use_flash=use_flash,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -52,6 +52,13 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     parser.add_argument("--mesh-fsdp", type=int, default=1)
     parser.add_argument("--mesh-tensor", type=int, default=1)
     parser.add_argument("--mesh-sequence", type=int, default=1)
+    parser.add_argument("--sp-mode", type=str, default=None,
+                        choices=("ring", "ulysses"),
+                        help="sequence parallelism: ring (K/V rotation, "
+                        "O(S_local) memory) or ulysses (all-to-all head "
+                        "swap; heads must divide the sequence axis). "
+                        "Default: the model's own default (llama: ulysses, "
+                        "others: ring)")
     parser.add_argument("--mesh-expert", type=int, default=1)
     parser.add_argument("--mesh-pipe", type=int, default=1,
                         help=">1: GPipe pipeline stages over the 'pipe' mesh "

@@ -187,7 +187,9 @@ def main():
         if args.flash != "auto":
             overrides["use_flash"] = args.flash == "on"
         if args.mesh_sequence not in (0, 1):
-            overrides["seq_axis"] = "sequence"  # ring attention over the mesh
+            overrides["seq_axis"] = "sequence"  # SP over the mesh
+            if args.sp_mode is not None:  # None: keep the model's default
+                overrides["sp_mode"] = args.sp_mode
     if args.pad_token_id is not None:
         if not args.model.startswith("bert"):
             parser.error(f"--pad-token-id is only supported for bert models, "
