@@ -62,14 +62,17 @@ def ulysses_attention(
     if q.shape[2] % p:
         raise ValueError(
             f"ulysses needs q heads ({q.shape[2]}) divisible by the "
-            f"sequence axis size ({p}); use ring attention otherwise"
+            f"sequence axis size ({p}); shrink the sequence axis, or use "
+            f"ring attention (equal-head MHA models only)"
         )
     kv_heads = k.shape[2]
     if kv_heads % p:
         if p % kv_heads:
             raise ValueError(
                 f"ulysses needs kv heads ({kv_heads}) to divide or be "
-                f"divided by the sequence axis size ({p})"
+                f"divided by the sequence axis size ({p}); shrink the "
+                f"sequence axis (ring attention is only an alternative "
+                f"for equal-head MHA models)"
             )
         # GQA with fewer kv heads than devices: replicate kv heads up to
         # the axis size (each q-head group still sees its correct kv head
@@ -105,14 +108,27 @@ def ulysses_attention_sharded(
     *,
     seq_axis: str = "sequence",
     batch_axes: Sequence[str] = ("data", "fsdp"),
+    heads_axis: str = "tensor",
     causal: bool = False,
     softmax_scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Ulysses attention on global (B, S, N, H) arrays: shard, swap, attend,
-    swap back. jit composes these specs with the surrounding program."""
+    swap back. When the mesh spans a ``heads_axis`` (tensor parallelism)
+    and the per-tensor-shard head count still divides the sequence axis,
+    the heads dim stays sharded over it — each tensor replica computes its
+    own head group instead of all-gathering heads. jit composes these specs
+    with the surrounding program."""
     batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
-    spec = P(batch, seq_axis, None, None)
+    tp = mesh.shape.get(heads_axis, 1)
+    heads = q.shape[2]
+    use_heads_axis = (
+        tp > 1
+        and heads % tp == 0
+        and (heads // tp) % mesh.shape[seq_axis] == 0
+        and (k.shape[2] % tp == 0)
+    )
+    spec = P(batch, seq_axis, heads_axis if use_heads_axis else None, None)
     fn = jax.shard_map(
         functools.partial(
             ulysses_attention,

@@ -83,6 +83,12 @@ def make_optimizer(
         opt = optax.sgd(lr_or_sched, momentum=momentum)
     elif name == "lamb":
         opt = optax.lamb(lr_or_sched, weight_decay=weight_decay)
+    elif name == "adafactor":
+        # sub-linear optimizer memory (factored second moments): the
+        # at-scale choice when Adam's moments don't fit even under FSDP
+        opt = optax.adafactor(
+            lr_or_sched, weight_decay_rate=weight_decay or None
+        )
     else:
         raise ValueError(f"Unknown optimizer {name!r}")
     # flags are independent of the optimizer choice, so a silently-dropped

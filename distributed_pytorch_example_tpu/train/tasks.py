@@ -98,15 +98,24 @@ class MLMTask:
 
     On-device BERT masking recipe: select ``mask_rate`` of positions; of
     those, 80% → [MASK], 10% → random token, 10% → unchanged; loss only on
-    selected positions.
+    selected positions. ``pad_token_id`` (real padded corpora) excludes pad
+    positions from masking and from the loss — pair it with the model's
+    own ``pad_token_id`` so padding is also out of attention.
     """
 
     batch_keys = ("tokens",)
 
-    def __init__(self, vocab_size: int, mask_token_id: int, mask_rate: float = 0.15):
+    def __init__(
+        self,
+        vocab_size: int,
+        mask_token_id: int,
+        mask_rate: float = 0.15,
+        pad_token_id: int | None = None,
+    ):
         self.vocab_size = vocab_size
         self.mask_token_id = mask_token_id
         self.mask_rate = mask_rate
+        self.pad_token_id = pad_token_id
 
     def compute_loss(
         self, model, params, model_state, batch, rng, *, train: bool
@@ -116,10 +125,22 @@ class MLMTask:
             jax.random.fold_in(rng, 1), 4
         )
         selected = jax.random.uniform(rng_sel, tokens.shape) < self.mask_rate
+        if self.pad_token_id is not None:
+            selected &= tokens != self.pad_token_id
         kind = jax.random.uniform(rng_kind, tokens.shape)
-        random_tokens = jax.random.randint(
-            rng_rand, tokens.shape, 0, self.vocab_size, dtype=tokens.dtype
-        )
+        if self.pad_token_id is None:
+            random_tokens = jax.random.randint(
+                rng_rand, tokens.shape, 0, self.vocab_size, dtype=tokens.dtype
+            )
+        else:
+            # the 10% random-replacement draw must never inject a fake pad
+            # into a real scored position (the model would drop it from
+            # attention keys): sample [0, vocab-1) and skip over pad_id
+            r = jax.random.randint(
+                rng_rand, tokens.shape, 0, self.vocab_size - 1,
+                dtype=tokens.dtype,
+            )
+            random_tokens = jnp.where(r >= self.pad_token_id, r + 1, r)
         masked_inputs = jnp.where(
             selected & (kind < 0.8),
             jnp.asarray(self.mask_token_id, tokens.dtype),

@@ -101,8 +101,10 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         help="JSONL epoch-metrics path (default: "
                         "<checkpoint-dir>/metrics.jsonl)")
     parser.add_argument("--optimizer", type=str, default="adam",
-                        choices=("adam", "adamw", "sgd", "lamb"),
-                        help="reference default: adam (train.py:249)")
+                        choices=("adam", "adamw", "sgd", "lamb", "adafactor"),
+                        help="reference default: adam (train.py:249); "
+                        "adafactor = factored moments (sub-linear optimizer "
+                        "memory)")
     parser.add_argument("--schedule", type=str, default="constant",
                         choices=("constant", "cosine", "linear"))
     parser.add_argument("--warmup-steps", type=int, default=0)

@@ -93,7 +93,10 @@ def build_task(args, model):
         return dpx_train.ClassificationTask()
     if args.model.startswith("bert"):
         vocab = getattr(model, "vocab_size", 30522)
-        return dpx_train.MLMTask(vocab_size=vocab, mask_token_id=103)
+        return dpx_train.MLMTask(
+            vocab_size=vocab, mask_token_id=103,
+            pad_token_id=args.pad_token_id,
+        )
     return dpx_train.CausalLMTask()
 
 
@@ -190,6 +193,9 @@ def main():
             overrides["seq_axis"] = "sequence"  # SP over the mesh
             if args.sp_mode is not None:  # None: keep the model's default
                 overrides["sp_mode"] = args.sp_mode
+        elif args.sp_mode is not None:
+            parser.error("--sp-mode has no effect without --mesh-sequence "
+                         "> 1; set the sequence axis too")
     if args.pad_token_id is not None:
         if not args.model.startswith("bert"):
             parser.error(f"--pad-token-id is only supported for bert models, "
