@@ -122,11 +122,18 @@ def ulysses_attention_sharded(
     batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
     tp = mesh.shape.get(heads_axis, 1)
     heads = q.shape[2]
+    seq_size = mesh.shape[seq_axis]
+
+    def _local_kv_ok() -> bool:
+        lkv = k.shape[2] // tp  # kv heads per tensor shard
+        return lkv % seq_size == 0 or seq_size % lkv == 0
+
     use_heads_axis = (
         tp > 1
         and heads % tp == 0
-        and (heads // tp) % mesh.shape[seq_axis] == 0
-        and (k.shape[2] % tp == 0)
+        and (heads // tp) % seq_size == 0
+        and k.shape[2] % tp == 0
+        and _local_kv_ok()
     )
     spec = P(batch, seq_axis, heads_axis if use_heads_axis else None, None)
     fn = jax.shard_map(

@@ -95,12 +95,37 @@ class Partitioner:
         self.rules = [(re.compile(pattern), spec) for pattern, spec in rules]
         self.default = default
 
+    def _fits(self, spec: P, shape: Tuple[int, ...]) -> bool:
+        """Whether ``spec`` is applicable to a leaf of this shape.
+
+        Rules match by PATH, but some state trees reuse param paths with
+        different ranks (optax adafactor's factored v_row/v_col are rank-1
+        under rank-2 param paths) — a fixed-rank spec must then fall back
+        rather than crash device_put.
+        """
+        import math
+
+        if len(spec) > len(shape):
+            return False
+        for dim, entry in enumerate(spec):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            size = math.prod(self.mesh.shape[a] for a in axes)
+            if shape[dim] % size:
+                return False
+        return True
+
     def spec_for(self, path: str, shape: Tuple[int, ...]) -> P:
         for pattern, spec in self.rules:
             if pattern.search(path):
-                return spec(shape) if callable(spec) else spec
+                s = spec(shape) if callable(spec) else spec
+                if self._fits(s, shape):
+                    return s
+                break  # matched rule unfit for this rank/shape: use default
         d = self.default
-        return d(shape) if callable(d) else d
+        s = d(shape) if callable(d) else d
+        return s if self._fits(s, shape) else P()
 
     def tree_specs(self, tree: Any) -> Any:
         """PartitionSpec per leaf (tree may hold arrays or ShapeDtypeStructs)."""

@@ -65,7 +65,33 @@ def transformer_partitioner(
     ``fsdp_rest=True`` composes TP with ZeRO-style sharding: any leaf not
     matched by a TP rule (embeddings, norms, conv stems) is sharded along its
     largest dim on the ``fsdp`` axis.
+
+    Vocab parallelism: token-embedding tables and untied LM heads shard
+    their vocab dim on ``tensor`` when it divides — the embedding gather
+    and the (B, S, V) logits/softmax-CE reduction partition with them (XLA
+    inserts the collectives), so the biggest matmul and table never
+    replicate across tensor shards. Indivisible vocab sizes fall back to
+    the default policy.
     """
-    rules: list[Rule] = list(TRANSFORMER_TP_RULES)
     default = shard_largest_axis("fsdp", mesh) if fsdp_rest else P()
+
+    def _default_spec(shape):
+        return default(shape) if callable(default) else default
+
+    tsize = mesh.shape.get("tensor", 1)
+
+    def vocab_embed(shape):  # (V, D)
+        if tsize > 1 and shape and shape[0] % tsize == 0:
+            return P("tensor", None)
+        return _default_spec(shape)
+
+    def vocab_head(shape):  # (D, V)
+        if tsize > 1 and shape and shape[-1] % tsize == 0:
+            return P(None, "tensor")
+        return _default_spec(shape)
+
+    rules: list[Rule] = list(TRANSFORMER_TP_RULES) + [
+        (r"(wte|tok_embed)/embedding$", vocab_embed),
+        (r"lm_head$", vocab_head),
+    ]
     return Partitioner(mesh, rules=rules, default=default)

@@ -212,3 +212,41 @@ class TestSpaceToDepthStem:
         assert n_params(ResNet50(), x) == n_params(
             ResNet50(space_to_depth_stem=True), x
         )
+
+
+def test_vocab_sharding_when_divisible(devices):
+    """Divisible vocab shards on 'tensor'; indivisible falls back."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+    from distributed_pytorch_example_tpu.parallel.partition import (
+        transformer_partitioner,
+    )
+    from distributed_pytorch_example_tpu.runtime import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(data=2, tensor=4))
+    part = transformer_partitioner(mesh)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    model = GPT2(vocab_size=128, max_len=32, model_dim=32, num_layers=1,
+                 num_heads=4, mlp_dim=64)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, (4, 16)), jnp.int32
+    )
+    variables = model.init(jax.random.key(0), tokens, train=False)
+    specs = part.tree_specs(variables)["params"]
+    assert specs["wte"]["embedding"] == P("tensor", None)  # 128 % 4 == 0
+    # TP equivalence with the vocab-sharded table
+    expected = model.apply(variables, tokens, train=False)
+    sharded = jax.device_put(variables, part.tree_shardings(variables))
+    got = jax.jit(lambda v, t: model.apply(v, t, train=False))(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-4)
+
+    # vocab 101 % 4 != 0: falls back to replicated (the default policy)
+    m2 = GPT2(vocab_size=101, max_len=32, model_dim=32, num_layers=1,
+              num_heads=4, mlp_dim=64)
+    v2 = m2.init(jax.random.key(0), tokens, train=False)
+    assert part.tree_specs(v2)["params"]["wte"]["embedding"] == P()

@@ -184,7 +184,13 @@ def main():
     overrides = {"dtype": dtype}
     if args.model in ("mlp",) or args.model.startswith("resnet") or args.model.startswith("vit"):
         overrides["num_classes"] = args.num_classes
-    if args.model.startswith(("vit", "bert", "gpt", "llama")):
+    is_transformer = args.model.startswith(("vit", "bert", "gpt", "llama"))
+    if args.sp_mode is not None and not (
+        is_transformer and args.mesh_sequence not in (0, 1)
+    ):
+        parser.error("--sp-mode has no effect without a transformer model "
+                     "and --mesh-sequence > 1")
+    if is_transformer:
         if args.remat:
             overrides["remat"] = True
         if args.flash != "auto":
@@ -193,9 +199,6 @@ def main():
             overrides["seq_axis"] = "sequence"  # SP over the mesh
             if args.sp_mode is not None:  # None: keep the model's default
                 overrides["sp_mode"] = args.sp_mode
-        elif args.sp_mode is not None:
-            parser.error("--sp-mode has no effect without --mesh-sequence "
-                         "> 1; set the sequence axis too")
     if args.pad_token_id is not None:
         if not args.model.startswith("bert"):
             parser.error(f"--pad-token-id is only supported for bert models, "
