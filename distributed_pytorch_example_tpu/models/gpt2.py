@@ -39,6 +39,7 @@ class GPT2(nn.Module):
     moe_capacity_factor: float = 1.25
     pipe_axis: Optional[str] = None  # mesh axis for pipeline stages (PP)
     pipe_microbatches: int = 0  # 0 = auto
+    decode: bool = False  # autoregressive KV-cache mode (train/generate.py)
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
@@ -49,6 +50,13 @@ class GPT2(nn.Module):
             )
         if self.pipe_axis is not None and self.dropout_rate:
             raise ValueError("pipelined GPT-2 requires dropout_rate=0")
+        if self.pipe_axis is not None and self.decode:
+            raise ValueError(
+                "decode (KV-cache generation) is not supported on the "
+                "pipelined path; construct the decode model without "
+                "pipe_axis (params are layout-incompatible with the "
+                "stacked decoder anyway)"
+            )
         # tokens: (B, S) int32 → logits (B, S, vocab)
         embed = nn.Embed(
             self.vocab_size,
@@ -61,7 +69,24 @@ class GPT2(nn.Module):
             nn.initializers.normal(stddev=0.01),
             (1, self.max_len, self.model_dim),
         )
-        x = embed(tokens).astype(self.dtype) + pos[:, : tokens.shape[1]].astype(self.dtype)
+        if self.decode:
+            # position cursor mirrors the attention caches' cache_index
+            cursor = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if self.is_initializing():
+                pos_slice = pos[:, : tokens.shape[1]]
+            else:
+                import jax
+
+                pos_slice = jax.lax.dynamic_slice(
+                    pos, (0, cursor.value, 0),
+                    (1, tokens.shape[1], self.model_dim),
+                )
+                cursor.value = cursor.value + tokens.shape[1]
+        else:
+            pos_slice = pos[:, : tokens.shape[1]]
+        x = embed(tokens).astype(self.dtype) + pos_slice.astype(self.dtype)
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
 
@@ -100,6 +125,7 @@ class GPT2(nn.Module):
                 use_flash=self.use_flash,
                 seq_axis=self.seq_axis,
                 sp_mode=self.sp_mode,
+                decode=self.decode,
                 remat=self.remat,
                 moe_experts=self.moe_experts,
                 moe_every=self.moe_every,

@@ -76,6 +76,7 @@ class LlamaBlock(nn.Module):
     use_flash: Optional[bool] = None
     seq_axis: Optional[str] = None
     sp_mode: str = "ulysses"  # GQA needs the all-to-all SP path
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -91,6 +92,7 @@ class LlamaBlock(nn.Module):
             rope=True,
             rope_theta=self.rope_theta,
             sp_mode=self.sp_mode,
+            decode=self.decode,
             name="attn",
         )
         mlp = SwiGluMlp(
@@ -118,6 +120,7 @@ class Llama(nn.Module):
     use_flash: Optional[bool] = None
     seq_axis: Optional[str] = None
     sp_mode: str = "ulysses"
+    decode: bool = False
     remat: bool = False
 
     @nn.compact
@@ -143,6 +146,7 @@ class Llama(nn.Module):
                 use_flash=self.use_flash,
                 seq_axis=self.seq_axis,
                 sp_mode=self.sp_mode,
+                decode=self.decode,
                 name=f"layer_{i}",
             )
             if self.remat:

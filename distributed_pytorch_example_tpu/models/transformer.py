@@ -64,6 +64,7 @@ class MultiHeadAttention(nn.Module):
     rope: bool = False  # rotary embeddings on q/k (LLaMA-style)
     rope_theta: float = 10000.0
     sp_mode: str = "ring"  # sequence parallelism: "ring" | "ulysses"
+    decode: bool = False  # autoregressive KV-cache mode (train/generate.py)
 
     @nn.compact
     def __call__(self, x, mask=None, *, kv_mask=None, train: bool = False):
@@ -86,6 +87,19 @@ class MultiHeadAttention(nn.Module):
         q = q.reshape(batch, seq, self.num_heads, self.head_dim)
         k = k.reshape(batch, seq, kv_heads, self.head_dim)
         v = v.reshape(batch, seq, kv_heads, self.head_dim)
+
+        if self.decode:
+            if not self.causal or mask is not None or kv_mask is not None \
+                    or self.seq_axis is not None:
+                raise ValueError(
+                    "decode mode supports causal attention only, without "
+                    "masks or sequence parallelism"
+                )
+            out = self._decode_step(q, k, v, batch, seq, kv_heads)
+            out = out.reshape((batch, seq, features))
+            out = nn.Dense(self.model_dim, dtype=self.dtype, name="o")(out)
+            return out
+
         if self.rope:
             from distributed_pytorch_example_tpu.ops.rope import rope
 
@@ -128,6 +142,57 @@ class MultiHeadAttention(nn.Module):
         if self.dropout_rate:
             out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
         return out
+
+    def _decode_step(self, q, k, v, batch, seq, kv_heads):
+        """KV-cache attention: write this call's K/V at the cache cursor,
+        attend the new queries against everything cached so far.
+
+        The cache is created at init time with the full sequence length
+        (``generate`` inits the model on a max-length dummy); decode calls
+        then feed 1..n new tokens. Positions come from the cursor, so RoPE
+        stays globally consistent across incremental calls.
+        """
+        from jax import lax
+
+        is_init = self.has_variable("cache", "cached_key")
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros,
+            (batch, seq, kv_heads, self.head_dim), self.dtype,
+        )
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros,
+            (batch, seq, kv_heads, self.head_dim), self.dtype,
+        )
+        cursor = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        if not is_init:  # init pass: just size the cache, output is unused
+            return jnp.zeros(
+                (batch, seq, self.num_heads, self.head_dim), self.dtype
+            )
+
+        idx = cursor.value
+        positions = idx + jnp.arange(seq)
+        if self.rope:
+            from distributed_pytorch_example_tpu.ops.rope import rope
+
+            q = rope(q, positions=positions, theta=self.rope_theta)
+            k = rope(k, positions=positions, theta=self.rope_theta)
+        cached_k.value = lax.dynamic_update_slice(
+            cached_k.value, k.astype(cached_k.value.dtype), (0, idx, 0, 0)
+        )
+        cached_v.value = lax.dynamic_update_slice(
+            cached_v.value, v.astype(cached_v.value.dtype), (0, idx, 0, 0)
+        )
+        cursor.value = idx + seq
+        cache_len = cached_k.value.shape[1]
+        # causal against the cursor: new query t may see keys [0, idx + t]
+        key_pos = jnp.arange(cache_len)[None, None, None, :]
+        visible = key_pos <= positions[None, None, :, None]
+        return dot_product_attention(
+            q, cached_k.value, cached_v.value, mask=visible, causal=False,
+            use_flash=False,  # 1..n-token queries: XLA path is right-sized
+        )
 
     def _ring_mesh(self, mask):
         """The active mesh when ring attention should run, else None.
@@ -190,6 +255,7 @@ class TransformerBlock(nn.Module):
     use_flash: Optional[bool] = None
     seq_axis: Optional[str] = None
     sp_mode: str = "ring"
+    decode: bool = False
     moe_experts: int = 0  # >0: Mixture-of-Experts MLP with this many experts
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
@@ -206,6 +272,7 @@ class TransformerBlock(nn.Module):
             use_flash=self.use_flash,
             seq_axis=self.seq_axis,
             sp_mode=self.sp_mode,
+            decode=self.decode,
             name="attn",
         )
         if self.moe_experts:
@@ -260,6 +327,7 @@ class TransformerStack(nn.Module):
     use_flash: Optional[bool] = None
     seq_axis: Optional[str] = None
     sp_mode: str = "ring"
+    decode: bool = False
     remat: bool = False
     moe_experts: int = 0
     moe_every: int = 2  # MoE MLP on every Nth block (Switch uses 2)
@@ -288,6 +356,7 @@ class TransformerStack(nn.Module):
                 use_flash=self.use_flash,
                 seq_axis=self.seq_axis,
                 sp_mode=self.sp_mode,
+                decode=self.decode,
                 moe_experts=self.moe_experts if is_moe else 0,
                 moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,

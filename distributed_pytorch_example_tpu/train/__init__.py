@@ -24,3 +24,4 @@ from distributed_pytorch_example_tpu.train.checkpoint import (  # noqa: F401
     save_checkpoint,
 )
 from distributed_pytorch_example_tpu.train.loop import Trainer  # noqa: F401
+from distributed_pytorch_example_tpu.train.generate import generate  # noqa: F401

@@ -185,17 +185,17 @@ def main():
     if args.model in ("mlp",) or args.model.startswith("resnet") or args.model.startswith("vit"):
         overrides["num_classes"] = args.num_classes
     is_transformer = args.model.startswith(("vit", "bert", "gpt", "llama"))
-    if args.sp_mode is not None and not (
-        is_transformer and args.mesh_sequence not in (0, 1)
-    ):
+    # the RESOLVED axis size, not the raw flag: -1 may absorb to size 1
+    seq_span = mesh.shape["sequence"]
+    if args.sp_mode is not None and not (is_transformer and seq_span > 1):
         parser.error("--sp-mode has no effect without a transformer model "
-                     "and --mesh-sequence > 1")
+                     "and a sequence mesh axis spanning > 1 devices")
     if is_transformer:
         if args.remat:
             overrides["remat"] = True
         if args.flash != "auto":
             overrides["use_flash"] = args.flash == "on"
-        if args.mesh_sequence not in (0, 1):
+        if seq_span > 1:
             overrides["seq_axis"] = "sequence"  # SP over the mesh
             if args.sp_mode is not None:  # None: keep the model's default
                 overrides["sp_mode"] = args.sp_mode
