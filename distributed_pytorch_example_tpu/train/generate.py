@@ -40,10 +40,10 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int]):
 @partial(
     jax.jit,
     static_argnums=(0, 3),
-    static_argnames=("temperature", "top_k"),
+    static_argnames=("temperature", "top_k", "eos_id"),
 )
 def _generate_jit(model, params, prompt, max_new_tokens, rng, *,
-                  temperature, top_k):
+                  temperature, top_k, eos_id):
     batch, prompt_len = prompt.shape
     cache_len = prompt_len + max_new_tokens
     # size the caches on a full-length dummy (params from init are unused)
@@ -59,19 +59,28 @@ def _generate_jit(model, params, prompt, max_new_tokens, rng, *,
     )
     rng, sub = jax.random.split(rng)
     first = _sample(logits[:, -1], sub, temperature, top_k)
+    done0 = (
+        first == eos_id if eos_id is not None
+        else jnp.zeros((batch,), bool)
+    )
 
     def step(carry, _):
-        cache, tok, rng = carry
+        cache, tok, done, rng = carry
         rng, sub = jax.random.split(rng)
         logits, vars_ = model.apply(
             {"params": params, "cache": cache}, tok[:, None], train=False,
             mutable=["cache"],
         )
         nxt = _sample(logits[:, -1], sub, temperature, top_k)
-        return (vars_["cache"], nxt, rng), nxt
+        if eos_id is not None:
+            # static shapes: sequences past their EOS keep emitting EOS
+            nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+            done = done | (nxt == eos_id)
+        return (vars_["cache"], nxt, done, rng), nxt
 
-    (_, _, _), rest = jax.lax.scan(
-        step, (vars_["cache"], first, rng), None, length=max_new_tokens - 1
+    (_, _, _, _), rest = jax.lax.scan(
+        step, (vars_["cache"], first, done0, rng), None,
+        length=max_new_tokens - 1,
     )
     new_tokens = jnp.concatenate([first[:, None], rest.T], axis=1)
     return jnp.concatenate([prompt, new_tokens], axis=1)
@@ -85,13 +94,16 @@ def generate(
     *,
     temperature: float = 1.0,
     top_k: Optional[int] = None,
+    eos_id: Optional[int] = None,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` (B, P) int32.
 
     ``model`` must be constructed with ``decode=True`` (GPT-2 / LLaMA).
     ``temperature=0`` is greedy argmax decoding; ``top_k`` truncates the
-    sampling distribution. Returns (B, P + max_new_tokens) token ids.
+    sampling distribution; with ``eos_id``, sequences keep emitting EOS
+    after their first one (shapes stay static — trim on host). Returns
+    (B, P + max_new_tokens) token ids.
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
@@ -104,5 +116,5 @@ def generate(
         rng = jax.random.key(0)
     return _generate_jit(
         model, params, prompt, max_new_tokens, rng,
-        temperature=temperature, top_k=top_k,
+        temperature=temperature, top_k=top_k, eos_id=eos_id,
     )

@@ -71,3 +71,75 @@ def test_generate_requires_decode_model():
     model = GPT2(**GPT2_KW)
     with pytest.raises(ValueError, match="decode=True"):
         generate(model, {}, jnp.zeros((1, 4), jnp.int32), 4)
+
+
+def test_eos_freezes_finished_sequences():
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+
+    model = GPT2(**GPT2_KW, decode=True)
+    train_model = GPT2(**GPT2_KW)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    params = train_model.init(jax.random.key(0), prompt)["params"]
+    # stochastic baseline without EOS, fixed rng
+    rng = jax.random.key(3)
+    free = np.asarray(
+        generate(model, params, prompt, 12, temperature=1.0, rng=rng)
+    )[0, 4:]
+    # declare the SECOND sampled token to be EOS: it provably occurs, and
+    # the frozen run shares rng consumption so pre-EOS draws are identical
+    eos = int(free[1])
+    frozen = np.asarray(
+        generate(model, params, prompt, 12, temperature=1.0, eos_id=eos,
+                 rng=rng)
+    )[0, 4:]
+    hit = int(np.where(frozen == eos)[0][0])
+    np.testing.assert_array_equal(frozen[: hit + 1], free[: hit + 1])
+    np.testing.assert_array_equal(frozen[hit:], eos)  # frozen after EOS
+    # the free run kept sampling past it (else the assertion is vacuous)
+    assert not (free[hit:] == eos).all()
+
+
+def test_trained_model_generates_learned_pattern(devices):
+    """The whole stack coheres: train LLaMA on a successor language
+    (token t+1 = token t + 1 mod V), then cached greedy generation must
+    reproduce the rule exactly."""
+    import optax
+
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.models.llama import Llama
+
+    V, S = 32, 16
+    kw = dict(vocab_size=V, max_len=64, model_dim=64, num_layers=2,
+              num_heads=4, num_kv_heads=2, mlp_dim=128)
+
+    # successor-language corpus: rows are consecutive ints mod V
+    rng = np.random.default_rng(0)
+    starts = rng.integers(0, V, (512,))
+    data = (starts[:, None] + np.arange(S)[None, :]) % V
+
+    class _Successor:
+        def __len__(self):
+            return len(data)
+
+        def get_batch(self, idx):
+            return {"tokens": data[idx].astype(np.int32)}
+
+    mesh = dpx.runtime.make_mesh()
+    loader = dpx.data.DeviceLoader(
+        _Successor(), 64, mesh=mesh, num_shards=1, shard_id=0, seed=0
+    )
+    trainer = dpx.train.Trainer(
+        Llama(**kw), dpx.train.CausalLMTask(), optax.adam(3e-3),
+        partitioner=dpx.parallel.data_parallel(mesh),
+    )
+    history = trainer.fit(loader, epochs=25)
+    assert history[-1]["train_loss"] < 0.1, history[-1]
+
+    decode_model = Llama(**kw, decode=True)
+    prompt = jnp.asarray([[7, 8, 9, 10], [30, 31, 0, 1]], jnp.int32)
+    out = np.asarray(
+        generate(decode_model, trainer.state.params, prompt, 12,
+                 temperature=0.0)
+    )
+    expected = (out[:, 3:4] + np.arange(1, 13)) % V
+    np.testing.assert_array_equal(out[:, 4:], expected)
