@@ -90,3 +90,53 @@ def test_mesh_spec_errors(devices):
         MeshSpec(data=3, fsdp=1).resolve(8)  # not divisible
     with pytest.raises(ValueError):
         MeshSpec(data=-1, fsdp=-1).resolve(8)  # two unknowns
+
+
+class TestMultiSliceMesh:
+    """DCN-aware hybrid-mesh policy (decision logic; the hybrid call itself
+    needs real multi-slice hardware and falls back gracefully without it)."""
+
+    def test_hybrid_shapes_put_slices_on_data(self):
+        from distributed_pytorch_example_tpu.runtime.mesh import (
+            MeshSpec,
+            _hybrid_shapes,
+        )
+
+        spec = MeshSpec(data=8, tensor=4).resolve(32)
+        per_slice, dcn = _hybrid_shapes(spec, 2)
+        assert per_slice == (4, 1, 4, 1, 1, 1)  # data halved per slice
+        assert dcn == (2, 1, 1, 1, 1, 1)  # slice dim on 'data' only
+
+    def test_hybrid_declined_when_indivisible_or_single_slice(self):
+        from distributed_pytorch_example_tpu.runtime.mesh import (
+            MeshSpec,
+            _hybrid_shapes,
+        )
+
+        assert _hybrid_shapes(MeshSpec(data=3).resolve(3), 2) is None
+        assert _hybrid_shapes(MeshSpec(data=8).resolve(8), 1) is None
+
+    def test_num_slices_unknown_is_single(self):
+        from distributed_pytorch_example_tpu.runtime.mesh import _num_slices
+
+        class D:  # CPU devices: no slice_index attr
+            pass
+
+        assert _num_slices([D(), D()]) == 1
+
+        class S:
+            def __init__(self, i):
+                self.slice_index = i
+
+        assert _num_slices([S(0), S(0), S(1), S(1)]) == 2
+
+    def test_hybrid_falls_back_to_fsdp_axis_for_zero_configs(self):
+        from distributed_pytorch_example_tpu.runtime.mesh import (
+            MeshSpec,
+            _hybrid_shapes,
+        )
+
+        spec = MeshSpec(data=1, fsdp=-1).resolve(16)  # ZeRO: all on fsdp
+        per_slice, dcn = _hybrid_shapes(spec, 2)
+        assert per_slice == (1, 8, 1, 1, 1, 1)
+        assert dcn == (1, 2, 1, 1, 1, 1)  # slice dim on 'fsdp'

@@ -136,3 +136,26 @@ def test_rss_bounded_by_lru_window_not_dataset_size(tmp_path):
         f"RSS grew {grown_mb:.0f}MB over a {total_mb:.0f}MB dataset — "
         "streaming is not streaming"
     )
+
+
+@pytest.mark.slow
+def test_streaming_throughput_floor(tmp_path):
+    """Random-order streaming must sustain real bandwidth (memmap reads,
+    not per-sample file opens). Floor is intentionally loose (~50 MB/s);
+    actual page-cache-warm rates are orders of magnitude higher."""
+    import time
+
+    root = str(tmp_path / "tp")
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (2048, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, (2048,)).astype(np.int64)
+    write_image_shards(root, [(images, labels)], shard_size=256)
+    ds = StreamingImageShards(root, max_open_shards=4)
+    order = np.random.default_rng(1).permutation(len(ds))
+    ds.get_batch(order[:128])  # warm
+    t0 = time.perf_counter()
+    for lo in range(0, len(ds), 128):
+        ds.get_batch(order[lo : lo + 128])
+    dt = time.perf_counter() - t0
+    mb = len(ds) * 32 * 32 * 3 / 1e6
+    assert mb / dt > 50, f"streaming at {mb/dt:.1f} MB/s"
