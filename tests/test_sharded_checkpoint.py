@@ -160,3 +160,53 @@ def test_bad_checkpoint_format_rejected(devices):
             partitioner=dpx.parallel.data_parallel(mesh),
             checkpoint_format="bogus",
         )
+
+
+def test_stale_crashed_save_dir_is_cleaned_not_committed(tmp_path, devices):
+    """Leftover shard files from a killed save at the SAME epoch must not
+    be committed into the new checkpoint (the rendezvous checks existence,
+    so process 0 cleans the version dir before anyone writes)."""
+    from flax import serialization
+
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    state, shardings = _fsdp_state(mesh)
+    path = str(tmp_path / "ck")
+
+    # simulate a crashed prior save: version dir exists with garbage shard
+    # files (even extra ones from a larger imaginary job)
+    stale_dir = os.path.join(f"{path}.shards", "00000005")
+    os.makedirs(stale_dir)
+    for i in range(3):
+        with open(os.path.join(stale_dir, f"shard_{i:05d}.msgpack"), "wb") as f:
+            f.write(serialization.msgpack_serialize({"garbage": np.zeros(3)}))
+    # no manifest, no pointer: the crash happened before commit
+
+    ckpt_lib.save_checkpoint(path, state, 5, 0.0, sharded=True)
+    # the stale extra shard is gone; only this 1-process job's shard remains
+    names = sorted(os.listdir(stale_dir))
+    assert names == ["manifest.msgpack", "shard_00000.msgpack"]
+    restored, epoch, _ = ckpt_lib.load_checkpoint(path, state, shardings)
+    assert epoch == 5
+    _tree_equal(restored, state)
+
+
+def test_pointer_flips_only_after_manifest_commit(tmp_path, devices, monkeypatch):
+    """A reader mid-save sees either no pointer or a fully committed one:
+    the write ORDER must be shards -> manifest -> pointer (the pointer is
+    the last atomic write). Pinned by recording every atomic write."""
+    order = []
+    real = ckpt_lib._atomic_write
+
+    def spy(path, blob):
+        order.append(os.path.basename(path))
+        real(path, blob)
+
+    monkeypatch.setattr(ckpt_lib, "_atomic_write", spy)
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    state, _ = _fsdp_state(mesh)
+    path = str(tmp_path / "ck")
+    ckpt_lib.save_checkpoint(path, state, 1, 0.0, sharded=True)
+    assert order.index("manifest.msgpack") < order.index("ck"), order
+    assert order.index("shard_00000.msgpack") < order.index(
+        "manifest.msgpack"
+    ), order
