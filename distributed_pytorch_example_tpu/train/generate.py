@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 
 
-def _sample(logits, rng, temperature: float, top_k: Optional[int]):
+def _sample(logits, rng, temperature: float, top_k: Optional[int],
+            top_p: Optional[float]):
     """One sampling step on (B, V) logits."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -34,16 +35,25 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int]):
     if top_k is not None:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # whose mass reaches top_p (the first token always survives)
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cut = jnp.sum(cum - probs < top_p, axis=-1, keepdims=True)  # >= 1
+        threshold = jnp.take_along_axis(sorted_logits, cut - 1, axis=-1)
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
     return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 
 @partial(
     jax.jit,
     static_argnums=(0, 3),
-    static_argnames=("temperature", "top_k", "eos_id"),
+    static_argnames=("temperature", "top_k", "top_p", "eos_id"),
 )
 def _generate_jit(model, params, prompt, max_new_tokens, rng, *,
-                  temperature, top_k, eos_id):
+                  temperature, top_k, top_p, eos_id):
     batch, prompt_len = prompt.shape
     cache_len = prompt_len + max_new_tokens
     # size the caches on a full-length dummy (params from init are unused)
@@ -58,7 +68,7 @@ def _generate_jit(model, params, prompt, max_new_tokens, rng, *,
         mutable=["cache"],
     )
     rng, sub = jax.random.split(rng)
-    first = _sample(logits[:, -1], sub, temperature, top_k)
+    first = _sample(logits[:, -1], sub, temperature, top_k, top_p)
     done0 = (
         first == eos_id if eos_id is not None
         else jnp.zeros((batch,), bool)
@@ -71,7 +81,7 @@ def _generate_jit(model, params, prompt, max_new_tokens, rng, *,
             {"params": params, "cache": cache}, tok[:, None], train=False,
             mutable=["cache"],
         )
-        nxt = _sample(logits[:, -1], sub, temperature, top_k)
+        nxt = _sample(logits[:, -1], sub, temperature, top_k, top_p)
         if eos_id is not None:
             # static shapes: sequences past their EOS keep emitting EOS
             nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
@@ -94,19 +104,24 @@ def generate(
     *,
     temperature: float = 1.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     eos_id: Optional[int] = None,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` (B, P) int32.
 
     ``model`` must be constructed with ``decode=True`` (GPT-2 / LLaMA).
-    ``temperature=0`` is greedy argmax decoding; ``top_k`` truncates the
-    sampling distribution; with ``eos_id``, sequences keep emitting EOS
+    ``temperature=0`` is greedy argmax decoding; ``top_k``/``top_p``
+    (nucleus) truncate the sampling distribution; with ``eos_id``, sequences keep emitting EOS
     after their first one (shapes stay static — trim on host). Returns
     (B, P + max_new_tokens) token ids.
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        # top_p == 0 would wrap the nucleus cut index to -1 and silently
+        # disable truncation — the opposite of the caller's intent
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if not getattr(model, "decode", False):
         raise ValueError(
             "generate() needs a decode-mode model: construct it with "
@@ -116,5 +131,5 @@ def generate(
         rng = jax.random.key(0)
     return _generate_jit(
         model, params, prompt, max_new_tokens, rng,
-        temperature=temperature, top_k=top_k, eos_id=eos_id,
+        temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
     )

@@ -143,3 +143,29 @@ def test_trained_model_generates_learned_pattern(devices):
     )
     expected = (out[:, 3:4] + np.arange(1, 13)) % V
     np.testing.assert_array_equal(out[:, 4:], expected)
+
+
+def test_top_p_truncates_to_nucleus():
+    """With a peaked distribution and small top_p, sampling must only
+    ever draw the top token; the raw distribution would not."""
+    from distributed_pytorch_example_tpu.train.generate import _sample
+
+    logits = jnp.asarray([[4.0, 3.5, 0.0, -1.0]])  # top-1 prob ~0.61
+    draws = {
+        int(_sample(logits, jax.random.key(i), 1.0, None, 0.5)[0])
+        for i in range(50)
+    }
+    assert draws == {0}  # nucleus at p=0.5 is exactly the argmax token
+    free = {
+        int(_sample(logits, jax.random.key(i), 1.0, None, None)[0])
+        for i in range(50)
+    }
+    assert len(free) > 1  # unconstrained sampling spreads
+
+
+def test_invalid_top_p_rejected():
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+
+    model = GPT2(**GPT2_KW, decode=True)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, {}, jnp.zeros((1, 4), jnp.int32), 4, top_p=0.0)
