@@ -35,9 +35,22 @@ class BertBase(nn.Module):
     # attention — flash keeps its fast path (kv_mask streams through the
     # kernel). None = no padding mask (synthetic data has no pad tokens).
     pad_token_id: Optional[int] = None
+    # "full": (B, S, V) logits. "hidden": final MLM-head hidden states for
+    # the fused chunked-CE loss (train/tasks.py + ``head_params``).
+    logits_mode: str = "full"
+
+    @staticmethod
+    def head_params(params):
+        """Tied MLM-head weights for the fused loss: ((V, D) table, bias)."""
+        return params["tok_embed"]["embedding"], params["mlm_bias"]
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
+        if self.logits_mode not in ("full", "hidden"):
+            raise ValueError(
+                f"logits_mode must be 'full' or 'hidden', got "
+                f"{self.logits_mode!r}"
+            )
         # tokens: (B, S) int32 → logits (B, S, vocab)
         embed = nn.Embed(
             self.vocab_size,
@@ -58,11 +71,9 @@ class BertBase(nn.Module):
 
         kv_mask = None
         if self.pad_token_id is not None:
-            if self.seq_axis is not None:
-                raise ValueError(
-                    "pad_token_id cannot combine with seq_axis: the "
-                    "ring-attention path has no padding-mask support yet"
-                )
+            # streams through every attention path: dense XLA, flash, and
+            # both sequence-parallel modes (ring rotates the mask chunk
+            # with k/v; Ulysses all-gathers it after the head swap)
             kv_mask = tokens != self.pad_token_id
         x = TransformerStack(
             num_layers=self.num_layers,
@@ -86,10 +97,11 @@ class BertBase(nn.Module):
         x = nn.Dense(self.model_dim, dtype=self.dtype, name="mlm_dense")(x)
         x = nn.gelu(x)
         x = nn.LayerNorm(epsilon=1e-12, dtype=self.dtype, name="mlm_ln")(x)
+        bias = self.param("mlm_bias", nn.initializers.zeros_init(), (self.vocab_size,))
+        if self.logits_mode == "hidden":
+            return x
         from distributed_pytorch_example_tpu.models.transformer import (
             tied_head_logits,
         )
 
-        logits = tied_head_logits(x, embed.embedding, self.dtype)
-        bias = self.param("mlm_bias", nn.initializers.zeros_init(), (self.vocab_size,))
-        return logits + bias
+        return tied_head_logits(x, embed.embedding, self.dtype) + bias

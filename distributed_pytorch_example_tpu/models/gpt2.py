@@ -40,9 +40,25 @@ class GPT2(nn.Module):
     pipe_axis: Optional[str] = None  # mesh axis for pipeline stages (PP)
     pipe_microbatches: int = 0  # 0 = auto
     decode: bool = False  # autoregressive KV-cache mode (train/generate.py)
+    # "full": return (B, S, V) logits. "hidden": return the final hidden
+    # states instead, for the fused chunked-CE loss (train/tasks.py pairs
+    # it with ``head_params``) — the f32 logits tensor never materializes.
+    logits_mode: str = "full"
+
+    @staticmethod
+    def head_params(params):
+        """Tied LM-head weights for the fused loss: ((V, D) table, bias)."""
+        return params["wte"]["embedding"], None
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
+        if self.logits_mode not in ("full", "hidden"):
+            raise ValueError(
+                f"logits_mode must be 'full' or 'hidden', got "
+                f"{self.logits_mode!r}"
+            )
+        if self.decode and self.logits_mode != "full":
+            raise ValueError("decode mode requires logits_mode='full'")
         if self.pipe_axis is not None and (self.seq_axis or self.moe_experts):
             raise ValueError(
                 "pipe_axis cannot combine with seq_axis or moe_experts yet "
@@ -134,6 +150,8 @@ class GPT2(nn.Module):
                 name="decoder",
             )(x, train=train)
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="final_ln")(x)
+        if self.logits_mode == "hidden":
+            return x
         from distributed_pytorch_example_tpu.models.transformer import (
             tied_head_logits,
         )

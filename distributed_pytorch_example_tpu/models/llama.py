@@ -122,9 +122,26 @@ class Llama(nn.Module):
     sp_mode: str = "ulysses"
     decode: bool = False
     remat: bool = False
+    # "full": (B, S, V) logits. "hidden": final hidden states for the fused
+    # chunked-CE loss (train/tasks.py + ``head_params``).
+    logits_mode: str = "full"
+
+    @staticmethod
+    def head_params(params):
+        """Untied LM head transposed to the fused loss's (V, D) layout."""
+        import jax.numpy as jnp
+
+        return jnp.swapaxes(params["lm_head"], 0, 1), None
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
+        if self.logits_mode not in ("full", "hidden"):
+            raise ValueError(
+                f"logits_mode must be 'full' or 'hidden', got "
+                f"{self.logits_mode!r}"
+            )
+        if self.decode and self.logits_mode != "full":
+            raise ValueError("decode mode requires logits_mode='full'")
         # tokens: (B, S) int32 → logits (B, S, vocab); positions come from
         # RoPE inside attention — no learned position table
         x = nn.Embed(
@@ -164,6 +181,8 @@ class Llama(nn.Module):
             nn.initializers.normal(stddev=0.02),
             (self.model_dim, self.vocab_size),
         )
+        if self.logits_mode == "hidden":
+            return x
         return jax.lax.dot_general(
             x, head.astype(self.dtype),
             (((x.ndim - 1,), (0,)), ((), ())),

@@ -107,7 +107,7 @@ class MultiHeadAttention(nn.Module):
             k = rope(k, theta=self.rope_theta)
         # NB: RoPE above runs on the GLOBAL (pre-shard_map) arrays, so
         # positions are globally correct under either SP mode.
-        ring_mesh = self._ring_mesh(mask if mask is not None else kv_mask)
+        ring_mesh = self._ring_mesh(mask)
         if ring_mesh is not None and self.sp_mode == "ulysses":
             from distributed_pytorch_example_tpu.ops.ulysses import (
                 ulysses_attention_sharded,
@@ -115,7 +115,8 @@ class MultiHeadAttention(nn.Module):
 
             out = ulysses_attention_sharded(
                 q, k, v, ring_mesh, seq_axis=self.seq_axis,
-                causal=self.causal, use_flash=self.use_flash,
+                kv_mask=kv_mask, causal=self.causal,
+                use_flash=self.use_flash,
             )
         elif ring_mesh is not None:
             if kv_heads != self.num_heads:
@@ -130,7 +131,8 @@ class MultiHeadAttention(nn.Module):
 
             out = ring_attention_sharded(
                 q, k, v, ring_mesh, seq_axis=self.seq_axis,
-                causal=self.causal, use_flash=self.use_flash,
+                kv_mask=kv_mask, causal=self.causal,
+                use_flash=self.use_flash,
             )
         else:
             out = dot_product_attention(
@@ -195,17 +197,21 @@ class MultiHeadAttention(nn.Module):
         )
 
     def _ring_mesh(self, mask):
-        """The active mesh when ring attention should run, else None.
+        """The active mesh when sequence parallelism should run, else None.
 
         ``seq_axis`` set but no active mesh is a configuration error, not a
         fallback: silently taking the dense path would materialize the full
-        S x S logits the user sharded the sequence to avoid.
+        S x S logits the user sharded the sequence to avoid. Key-padding
+        ``kv_mask``s stream through both SP modes; only full (Q, K)
+        attention-matrix masks are unsupported.
         """
         if self.seq_axis is None:
             return None
         if mask is not None:
             raise NotImplementedError(
-                "custom masks are not supported on the ring-attention path"
+                "custom (Q, K) attention-matrix masks are not supported on "
+                "the sequence-parallel paths; key-padding masks go through "
+                "kv_mask"
             )
         from distributed_pytorch_example_tpu.runtime.mesh import current_mesh
 

@@ -1,0 +1,197 @@
+"""Chunked (vocab-blockwise) softmax cross-entropy for LM heads.
+
+The dense LM loss path materializes float32 logits of shape (B, S, V) —
+~1.6 GB per GPT-2 step at batch 8x1024xV50257 — writes them to HBM, then
+re-reads them for the softmax/CE reduction, and does it all again in the
+backward pass. On TPU that is pure HBM-bandwidth waste: the MXU produces
+logits faster than HBM can hold them.
+
+``chunked_softmax_xent`` fuses the tied-head matmul with the cross-entropy
+reduction, streaming over vocabulary blocks:
+
+- forward: one (N, D) x (D, Vb) matmul per block (bf16 operands, float32
+  accumulation on the MXU), a running max/logsumexp carried across blocks,
+  a gather-free target-logit term (select-by-column-id, no dynamic gather),
+  and a streaming argmax for the accuracy metric. Peak live logits are
+  (N, Vb) f32 instead of (N, V).
+- backward (custom VJP): recomputes each logits block, forms
+  ``(softmax - onehot) * g`` per block, accumulates ``dx`` across blocks and
+  writes each embedding-gradient block to its own disjoint (Vb, D) slice —
+  the (V, D) gradient is written exactly once, never read-modify-written.
+
+The block loop is a fully UNROLLED Python loop over static slices, not a
+``lax.scan``: ~13 blocks cost nothing to unroll, while the scan's while-loop
+machinery measured ~20% of a whole GPT-2 train step in the profiler (and
+hid the loop FLOPs from XLA's cost analysis, wrecking MFU accounting).
+Static slices also mean no padded copy of the embedding table and no
+valid-column masking — the last block is simply narrower.
+
+Loss semantics match ``optax.softmax_cross_entropy_with_integer_labels`` on
+float32 logits (the reference's ``nn.CrossEntropyLoss``, reference
+train.py:250) to float32 rounding; equivalence is pinned in
+``tests/test_chunked_ce.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+DEFAULT_BLOCK = 4096
+
+
+def _block_logits(x, e_blk, b_blk, dtype):
+    """f32 logits of one vocab block: (N, D) x (Vb, D)^T [+ bias]."""
+    out = lax.dot_general(
+        x, e_blk.astype(dtype),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if b_blk is not None:
+        out = out + b_blk.astype(jnp.float32)
+    return out
+
+
+def _blocks(vocab: int, block_size: int):
+    """Static (offset, width) spans covering [0, vocab); last may be narrow."""
+    spans = []
+    off = 0
+    while off < vocab:
+        spans.append((off, min(block_size, vocab - off)))
+        off += block_size
+    return spans
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _chunked_xent(x, embedding, bias, targets, block_size, dtype):
+    loss, argmax, _ = _forward(x, embedding, bias, targets, block_size, dtype)
+    return loss, argmax
+
+
+def _forward(x, embedding, bias, targets, block_size, dtype):
+    n = x.shape[0]
+    vocab = embedding.shape[0]
+    m = jnp.full((n,), -jnp.inf, jnp.float32)  # running max
+    s = jnp.zeros((n,), jnp.float32)  # running sum-exp
+    tl = jnp.zeros((n,), jnp.float32)  # target logit
+    best_v = jnp.full((n,), -jnp.inf, jnp.float32)
+    best_i = jnp.zeros((n,), jnp.int32)
+    for off, width in _blocks(vocab, block_size):
+        e_blk = lax.slice_in_dim(embedding, off, off + width)
+        b_blk = None if bias is None else lax.slice_in_dim(bias, off, off + width)
+        logits = _block_logits(x, e_blk, b_blk, dtype)  # (N, width) f32
+        col_ids = off + jnp.arange(width)  # (width,) global vocab ids
+        # gather-free target term: exactly one column matches per row (or
+        # none in this block), so a masked sum IS the gathered logit
+        hit = col_ids[None, :] == targets[:, None]
+        tl = tl + jnp.where(hit, logits, 0.0).sum(axis=1)
+        # streaming logsumexp
+        bm = jnp.max(logits, axis=1)
+        nm = jnp.maximum(m, bm)
+        s = s * jnp.exp(m - nm) + jnp.exp(logits - nm[:, None]).sum(axis=1)
+        m = nm
+        # streaming argmax (strict > keeps first-occurrence tie semantics)
+        bi = jnp.argmax(logits, axis=1).astype(jnp.int32) + off
+        better = bm > best_v
+        best_v = jnp.where(better, bm, best_v)
+        best_i = jnp.where(better, bi, best_i)
+    lse = m + jnp.log(s)
+    return lse - tl, best_i, lse
+
+
+def _fwd(x, embedding, bias, targets, block_size, dtype):
+    loss, argmax, lse = _forward(x, embedding, bias, targets, block_size, dtype)
+    return (loss, argmax), (x, embedding, bias, targets, lse)
+
+
+def _bwd(block_size, dtype, res, g):
+    x, embedding, bias, targets, lse = res
+    g_loss = g[0].astype(jnp.float32)  # argmax output is int: float0, ignored
+    vocab = embedding.shape[0]
+    dx = jnp.zeros(x.shape, jnp.float32)
+    de_blocks = []
+    db_blocks = []
+    for off, width in _blocks(vocab, block_size):
+        e_blk = lax.slice_in_dim(embedding, off, off + width)
+        b_blk = None if bias is None else lax.slice_in_dim(bias, off, off + width)
+        logits = _block_logits(x, e_blk, b_blk, dtype)  # (N, width) f32
+        col_ids = off + jnp.arange(width)
+        p = jnp.exp(logits - lse[:, None])
+        onehot = (col_ids[None, :] == targets[:, None]).astype(jnp.float32)
+        gmat = (p - onehot) * g_loss[:, None]  # (N, width) f32
+        dx = dx + lax.dot_general(  # (N, D) += (N, Vb) x (Vb, D)
+            gmat, e_blk.astype(dtype),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        de_blocks.append(lax.dot_general(  # (Vb, D) = (N, Vb)^T x (N, D)
+            gmat, x,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ))
+        if b_blk is not None:
+            db_blocks.append(gmat.sum(axis=0))
+    de = jnp.concatenate(de_blocks, axis=0)
+    dbias = None
+    if bias is not None:
+        dbias = jnp.concatenate(db_blocks, axis=0).astype(bias.dtype)
+    return (
+        dx.astype(x.dtype),
+        de.astype(embedding.dtype),
+        dbias,
+        np.zeros(targets.shape, dtype=jax.dtypes.float0),  # int input
+    )
+
+
+_chunked_xent.defvjp(_fwd, _bwd)
+
+
+def chunked_softmax_xent(
+    hidden: jax.Array,
+    embedding: jax.Array,
+    targets: jax.Array,
+    *,
+    bias: Optional[jax.Array] = None,
+    block_size: int = DEFAULT_BLOCK,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused tied-head matmul + softmax cross-entropy, blockwise over vocab.
+
+    Args:
+      hidden: (..., D) final hidden states (any leading dims).
+      embedding: (V, D) tied embedding / LM-head matrix (row-major vocab).
+      targets: (...) int target token ids, same leading dims as ``hidden``.
+      bias: optional (V,) logit bias (BERT's ``mlm_bias``).
+      block_size: vocab block width; peak live logits are (N, block) f32.
+      dtype: matmul operand dtype (bf16 keeps the MXU fed; accumulation is
+        always float32).
+
+    Returns:
+      ``(loss, argmax)``: per-position f32 cross-entropy of shape (...) and
+      the int32 argmax token id per position (for accuracy metrics) —
+      numerically equal to the dense
+      ``softmax_cross_entropy_with_integer_labels(f32_logits, targets)`` /
+      ``argmax(logits)`` pair without materializing (..., V) f32 logits.
+    """
+    lead = hidden.shape[:-1]
+    dim = hidden.shape[-1]
+    if embedding.shape[-1] != dim:
+        raise ValueError(
+            f"hidden dim {dim} != embedding dim {embedding.shape[-1]}"
+        )
+    if targets.shape != lead:
+        raise ValueError(
+            f"targets shape {targets.shape} != hidden leading dims {lead}"
+        )
+    n = 1
+    for d in lead:
+        n *= d
+    x = hidden.reshape(n, dim).astype(dtype)
+    t = targets.reshape(n).astype(jnp.int32)
+    loss, argmax = _chunked_xent(x, embedding, bias, t, int(block_size), dtype)
+    return loss.reshape(lead), argmax.reshape(lead)

@@ -133,6 +133,10 @@ def _fwd(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret):
     batch, heads, seq_q, head_dim = q.shape
     seq_k = k.shape[2]
     group = heads // k.shape[1]
+    if seq_k == block_k:  # whole key sequence in one block: plain softmax
+        return _fwd_single(
+            q, k, v, kv_mask, causal, scale, block_q, block_k, interpret
+        )
     grid = (batch, heads, seq_q // block_q, seq_k // block_k)
 
     qspec = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i, j: (b, n, i, 0))
@@ -175,6 +179,40 @@ def _fwd(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret):
     return out, lse
 
 
+def _fwd_single(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret):
+    batch, heads, seq_q, head_dim = q.shape
+    group = heads // k.shape[1]
+    grid = (batch, heads, seq_q // block_q)
+    qspec = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i: (b, n, i, 0))
+    kspec = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, n, i: (b, n // group, 0, 0)
+    )
+    has_mask = kv_mask is not None
+    in_specs = [qspec, kspec, kspec]
+    inputs = [q, k, v]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((1, 1, block_k), lambda b, n, i: (b, 0, 0)))
+        inputs.append(kv_mask)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_single_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, has_mask=has_mask,
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i: (b, n, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, n, i: (b, n, i, 0)),
+        ],
+        out_shape=[
+            _sds(q.shape, q.dtype, q),
+            _sds((batch, heads, seq_q, 1), jnp.float32, q),
+        ],
+        interpret=interpret,
+    )(*inputs)
+    return out, lse
+
+
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying ``like``'s varying-manual-axes set, so the
     kernels compose with shard_map manual axes (ring attention's folds)."""
@@ -188,6 +226,50 @@ def _vmem(shape, dtype=jnp.float32):
     from jax.experimental.pallas import tpu as pltpu
 
     return pltpu.VMEM(shape, dtype)
+
+
+def _fwd_single_kernel(
+    *refs, scale: float, causal: bool, block_q: int, block_k: int,
+    has_mask: bool,
+):
+    """One-k-block forward: plain tile softmax, no online-softmax carries.
+
+    When the whole key sequence fits one block (S_k == block_k — true for
+    both bench LM configs at the 1024 default), the running max/normalizer
+    scratch, their lane-replicated broadcasts, and the accumulator rescale
+    are pure VPU overhead; this variant computes the tile softmax directly.
+    """
+    if has_mask:
+        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        mask_ref = None
+    i = pl.program_id(2)
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        s = _apply_causal_mask(s, i, 0, block_q, block_k)
+    if mask_ref is not None:
+        valid = mask_ref[0, 0] > 0.0
+        s = jnp.where(valid[None, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / l
+    lse = m + jnp.log(l)
+    if mask_ref is not None:
+        dead = m == NEG_INF  # no valid key at all
+        o = jnp.where(dead, 0.0, o)
+        lse = jnp.where(dead, NEG_INF, lse)
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+    lse_ref[0, 0] = lse
 
 
 # ---------------------------------------------------------------------------
@@ -303,6 +385,91 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _bwd_single_kernel(
+    *refs, scale: float, causal: bool, block_q: int, block_k: int,
+    has_mask: bool,
+):
+    """One-tile fused backward: dq, dk, dv from a single logits recompute.
+
+    When both sequences fit one block, the separate dq and dk/dv kernels
+    each redo the s = qk^T matmul and the exp — the dominant VPU cost.
+    This variant computes p once and emits all three gradients.
+    """
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+         dq_ref, dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dk_ref, dv_ref) = refs
+        mask_ref = None
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0]  # (block_q, 1)
+    delta = delta_ref[0, 0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        s = _apply_causal_mask(s, 0, 0, block_q, block_k)
+    p = jnp.exp(s - lse)  # (block_q, block_k)
+    if mask_ref is not None:
+        p = jnp.where((mask_ref[0, 0] > 0.0)[None, :], p, 0.0)
+    dv_ref[0, 0] = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta) * scale
+    dq_ref[0, 0] = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dq_ref.dtype)
+    dk_ref[0, 0] = jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dk_ref.dtype)
+
+
+def _bwd_single(q, k, v, lse, do, delta, kv_mask, causal, scale, block_q,
+                block_k, interpret):
+    batch, heads, seq_q, head_dim = q.shape
+    seq_k = k.shape[2]
+    group = heads // k.shape[1]
+    grid = (batch, heads)
+    qspec = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n: (b, n, 0, 0))
+    kspec = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, n: (b, n // group, 0, 0)
+    )
+    # dK/dV accumulate PER Q-HEAD; group-summed by the caller (GQA)
+    kspec_out = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, n: (b, n, 0, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q, 1), lambda b, n: (b, n, 0, 0))
+    has_mask = kv_mask is not None
+    in_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
+    inputs = [q, k, v, do, lse, delta]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((1, 1, block_k), lambda b, n: (b, 0, 0)))
+        inputs.append(kv_mask)
+    return pl.pallas_call(
+        functools.partial(
+            _bwd_single_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, has_mask=has_mask,
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[qspec, kspec_out, kspec_out],
+        out_shape=[
+            _sds(q.shape, q.dtype, q),
+            _sds((batch, heads, seq_k, head_dim), k.dtype, q),
+            _sds((batch, heads, seq_k, head_dim), v.dtype, q),
+        ],
+        interpret=interpret,
+    )(*inputs)
+
+
 def _bwd(q, k, v, o, lse, do, kv_mask, causal, scale, block_q, block_k,
          interpret, delta=None):
     batch, heads, seq_q, head_dim = q.shape
@@ -315,6 +482,17 @@ def _bwd(q, k, v, o, lse, do, kv_mask, causal, scale, block_q, block_k,
         )  # (B, N, S, 1), same carry layout as lse
     # else: caller supplies the global delta (ring attention's chunk
     # backward, where o/do span ALL chunks but this call sees one)
+    if seq_q == block_q and seq_k == block_k:
+        # both sequences in one tile: fused dq/dk/dv kernel, one logits
+        # recompute + one exp instead of two of each
+        dq, dk, dv = _bwd_single(
+            q, k, v, lse, do, delta, kv_mask, causal, scale, block_q,
+            block_k, interpret,
+        )
+        if group > 1:
+            dk = dk.reshape(batch, k.shape[1], group, seq_k, head_dim).sum(2)
+            dv = dv.reshape(batch, v.shape[1], group, seq_k, head_dim).sum(2)
+        return dq, dk, dv
     has_mask = kv_mask is not None
 
     qspec = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i, j: (b, n, i, 0))
@@ -415,8 +593,8 @@ def flash_attention(
     causal: bool = False,
     kv_mask: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: bool = False,
 ) -> jax.Array:
     """Fused flash attention; (B, S, N, H) in and out.
@@ -428,10 +606,11 @@ def flash_attention(
 
     Sequence lengths must be multiples of the block sizes (the dispatcher in
     ops/attention.py guarantees this before selecting the flash path; blocks
-    shrink to the sequence length when it is shorter). 512x512 default
-    blocks measured fastest on v5e for head_dim 64 — small blocks pay too
-    many grid steps, and the larger logits tile amortizes the online-softmax
-    elementwise work against the MXU matmuls.
+    shrink to the sequence length when it is shorter). 1024x1024 default
+    blocks measured fastest on v5e for head_dim 64 (12-layer GPT-2-shape
+    chain: 0.67 ms/layer fwd vs 0.98 at 512x512, fwd+bwd 23.5 vs 30.0 ms) —
+    small blocks pay too many grid steps and per-step online-softmax
+    bookkeeping; the 4 MB f32 logits tile still sits comfortably in VMEM.
     """
     if softmax_scale is None:
         softmax_scale = q.shape[-1] ** -0.5

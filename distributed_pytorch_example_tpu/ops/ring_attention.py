@@ -55,17 +55,22 @@ def _pos_mask(idx, src, s_loc):
     return (q_pos >= k_pos)[None, :, None, :]
 
 
-def _chunk_fwd_xla(q, k, v, scale, causal, idx, src):
+def _chunk_fwd_xla(q, k, v, mask, scale, causal, idx, src):
     """Normalized chunk attention + lse in XLA ops; (B,S,N,H) ring layout.
 
-    Rows with no valid key (chunk entirely above the causal diagonal) emit
-    lse ≈ NEG_INF, so their garbage output vanishes in the lse merge.
+    ``mask``: optional (B, S_k_chunk) key-padding validity for THIS chunk's
+    keys (True=attend), rotated around the ring with k/v. Rows with no
+    valid key (chunk entirely above the causal diagonal, or all keys
+    padded) emit lse ≈ NEG_INF, so their garbage output vanishes in the
+    lse merge.
     """
     logits = jnp.einsum(
         "bqnh,bknh->bqnk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
     if causal:
         logits = jnp.where(_pos_mask(idx, src, q.shape[1]), logits, NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
     m = jnp.max(logits, axis=-1, keepdims=True)
     p = jnp.exp(logits - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
@@ -73,15 +78,21 @@ def _chunk_fwd_xla(q, k, v, scale, causal, idx, src):
     return o, m + jnp.log(l)  # lse: (B, S, N, 1)
 
 
-def _chunk_bwd_xla(q, k, v, g, lse, delta, scale, causal, idx, src):
+def _chunk_bwd_xla(q, k, v, mask, g, lse, delta, scale, causal, idx, src):
     """Chunk grads from the saved global lse; all math in float32."""
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
     gf = g.astype(jnp.float32)
     logits = jnp.einsum("bqnh,bknh->bqnk", qf, kf) * scale
     if causal:
         logits = jnp.where(_pos_mask(idx, src, q.shape[1]), logits, NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
     # p: GLOBAL softmax weights for this chunk's keys (lse spans all chunks)
     p = jnp.exp(logits - lse)
+    if mask is not None:
+        # fully-padded rows carry lse = NEG_INF: exp(NEG_INF - NEG_INF)
+        # garbage must not leak into dv/dk
+        p = jnp.where(mask[:, None, None, :], p, 0.0)
     dv = jnp.einsum("bqnk,bqnh->bknh", p, gf)
     dp = jnp.einsum("bqnh,bknh->bqnk", gf, vf)
     ds = p * (dp - delta) * scale
@@ -90,8 +101,11 @@ def _chunk_bwd_xla(q, k, v, g, lse, delta, scale, causal, idx, src):
     return dq, dk, dv
 
 
-def _chunk_fwd_flash(q, k, v, scale, causal, idx, src, interpret):
+def _chunk_fwd_flash(q, k, v, mask, scale, causal, idx, src, interpret):
     """Pallas-flash chunk fold: O(block) VMEM, returns (o f32, lse).
+
+    ``mask``: optional (B, S_k_chunk) key validity for this chunk, fed to
+    the flash kernel's kv_mask port as (B, 1, S_k) float.
 
     The (idx, src) relation picks the static kernel variant via
     ``lax.switch``: fully-visible chunk (non-causal kernel), diagonal chunk
@@ -106,12 +120,13 @@ def _chunk_fwd_flash(q, k, v, scale, causal, idx, src, interpret):
 
     s_loc = q.shape[1]
     block = _fit_block(s_loc, 512)  # must DIVIDE s_loc, not just cap it
+    kvm = None if mask is None else mask.astype(jnp.float32)[:, None, :]
 
     def run(causal_flag):
-        def f(q, k, v):
+        def f(q, k, v, kvm):
             qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
             out, lse = _fwd(
-                qt, kt, vt, None, causal_flag, scale, block, block, interpret
+                qt, kt, vt, kvm, causal_flag, scale, block, block, interpret
             )
             return (
                 out.transpose(0, 2, 1, 3).astype(jnp.float32),
@@ -121,9 +136,9 @@ def _chunk_fwd_flash(q, k, v, scale, causal, idx, src, interpret):
         return f
 
     if not causal:
-        return run(False)(q, k, v)
+        return run(False)(q, k, v, kvm)
 
-    def skip(q, k, v):
+    def skip(q, k, v, kvm):
         from distributed_pytorch_example_tpu.parallel.api import pvary_like
 
         b, s, n, h = q.shape
@@ -136,10 +151,11 @@ def _chunk_fwd_flash(q, k, v, scale, causal, idx, src, interpret):
         )
 
     mode = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
-    return lax.switch(mode, [run(False), run(True), skip], q, k, v)
+    return lax.switch(mode, [run(False), run(True), skip], q, k, v, kvm)
 
 
-def _chunk_bwd_flash(q, k, v, g, lse, delta, scale, causal, idx, src, interpret):
+def _chunk_bwd_flash(q, k, v, mask, g, lse, delta, scale, causal, idx, src,
+                     interpret):
     """Pallas-flash chunk backward from the global lse/delta."""
     from distributed_pytorch_example_tpu.ops.pallas.flash_attention import (
         _bwd,
@@ -148,12 +164,13 @@ def _chunk_bwd_flash(q, k, v, g, lse, delta, scale, causal, idx, src, interpret)
 
     s_loc = q.shape[1]
     block = _fit_block(s_loc, 512)  # must DIVIDE s_loc, not just cap it
+    kvm = None if mask is None else mask.astype(jnp.float32)[:, None, :]
 
     def run(causal_flag):
-        def f(q, k, v, g, lse, delta):
+        def f(q, k, v, kvm, g, lse, delta):
             qt, kt, vt, gt = (x.transpose(0, 2, 1, 3) for x in (q, k, v, g))
             dq, dk, dv = _bwd(
-                qt, kt, vt, None, lse.transpose(0, 2, 1, 3), gt, None,
+                qt, kt, vt, None, lse.transpose(0, 2, 1, 3), gt, kvm,
                 causal_flag, scale, block, block, interpret,
                 delta=delta.transpose(0, 2, 1, 3),
             )
@@ -165,9 +182,9 @@ def _chunk_bwd_flash(q, k, v, g, lse, delta, scale, causal, idx, src, interpret)
         return f
 
     if not causal:
-        return run(False)(q, k, v, g, lse, delta)
+        return run(False)(q, k, v, kvm, g, lse, delta)
 
-    def skip(q, k, v, g, lse, delta):
+    def skip(q, k, v, kvm, g, lse, delta):
         from distributed_pytorch_example_tpu.parallel.api import pvary_like
 
         return pvary_like(
@@ -181,7 +198,7 @@ def _chunk_bwd_flash(q, k, v, g, lse, delta, scale, causal, idx, src, interpret)
 
     mode = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
     return lax.switch(
-        mode, [run(False), run(True), skip], q, k, v, g, lse, delta
+        mode, [run(False), run(True), skip], q, k, v, kvm, g, lse, delta
     )
 
 
@@ -199,20 +216,28 @@ def _merge(o, lse, o_i, lse_i):
     )
 
 
-def _ring_fwd_impl(q, k, v, axis_name, causal, scale, flash, interpret):
+def _ring_fwd_impl(q, k, v, kv_mask, axis_name, causal, scale, flash,
+                   interpret):
     n_chunks = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     batch, s_loc, heads, head_dim = q.shape
     shift = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
+    has_mask = kv_mask is not None
+    # the mask chunk travels around the ring WITH its k/v chunk (float32:
+    # ppermute of sub-byte bools is wasteful on some backends, and the
+    # flash kernel wants float anyway)
+    m0 = kv_mask.astype(jnp.float32) if has_mask else None
 
-    chunk_fwd = _chunk_fwd_flash if flash else _chunk_fwd_xla
-
-    def fold(o, lse, k_cur, v_cur, src):
+    def fold(o, lse, k_cur, v_cur, m_cur, src):
+        mask = (m_cur > 0.0) if has_mask else None
         if flash:
-            o_i, lse_i = chunk_fwd(q, k_cur, v_cur, scale, causal, idx, src,
-                                   interpret)
+            o_i, lse_i = _chunk_fwd_flash(
+                q, k_cur, v_cur, mask, scale, causal, idx, src, interpret
+            )
         else:
-            o_i, lse_i = chunk_fwd(q, k_cur, v_cur, scale, causal, idx, src)
+            o_i, lse_i = _chunk_fwd_xla(
+                q, k_cur, v_cur, mask, scale, causal, idx, src
+            )
         return _merge(o, lse, o_i, lse_i)
 
     o0 = jnp.zeros((batch, s_loc, heads, head_dim), jnp.float32)
@@ -222,44 +247,67 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, scale, flash, interpret):
     o0, lse0 = pvary_like((o0, lse0), q)
 
     def body(carry, step):
-        k_cur, v_cur, o, lse = carry
+        if has_mask:
+            k_cur, v_cur, m_cur, o, lse = carry
+        else:
+            k_cur, v_cur, o, lse = carry
+            m_cur = None
         # start rotating the chunk we hold, then fold it: the transfer has
         # no dependence on the fold, so XLA overlaps them
         k_nxt = lax.ppermute(k_cur, axis_name, shift)
         v_nxt = lax.ppermute(v_cur, axis_name, shift)
         src = (idx - step) % n_chunks  # ring owner of the chunk we hold
-        o, lse = fold(o, lse, k_cur, v_cur, src)
+        o, lse = fold(o, lse, k_cur, v_cur, m_cur, src)
+        if has_mask:
+            m_nxt = lax.ppermute(m_cur, axis_name, shift)
+            return (k_nxt, v_nxt, m_nxt, o, lse), None
         return (k_nxt, v_nxt, o, lse), None
 
     if n_chunks > 1:
         # scan folds chunks 0..n-2 with rotation; the last chunk folds
         # outside so the ring makes exactly n-1 transfers (none discarded)
-        (k_last, v_last, o, lse), _ = lax.scan(
-            body, (k, v, o0, lse0), jnp.arange(n_chunks - 1)
+        carry0 = (k, v, m0, o0, lse0) if has_mask else (k, v, o0, lse0)
+        carry, _ = lax.scan(body, carry0, jnp.arange(n_chunks - 1))
+        if has_mask:
+            k_last, v_last, m_last, o, lse = carry
+        else:
+            (k_last, v_last, o, lse), m_last = carry, None
+        o, lse = fold(
+            o, lse, k_last, v_last, m_last, (idx - (n_chunks - 1)) % n_chunks
         )
-        o, lse = fold(o, lse, k_last, v_last, (idx - (n_chunks - 1)) % n_chunks)
     else:
-        o, lse = fold(o0, lse0, k, v, idx)
+        o, lse = fold(o0, lse0, k, v, m0, idx)
+    if has_mask:
+        # rows whose keys are masked in EVERY chunk: each fold emitted
+        # garbage at lse ~ NEG_INF, and with no finite-lse chunk to win the
+        # merge the garbage survives (the XLA fold's o is mean-of-values,
+        # not zero). Dense-path parity: zero output for fully-padded rows.
+        # (The backward needs no twin guard: its per-chunk re-mask already
+        # zeroes p for masked columns.)
+        o = jnp.where(lse <= NEG_INF * 0.5, 0.0, o)
     return o.astype(q.dtype), lse
 
 
-def _ring_bwd_impl(q, k, v, out, lse, g, axis_name, causal, scale, flash,
-                   interpret):
+def _ring_bwd_impl(q, k, v, kv_mask, out, lse, g, axis_name, causal, scale,
+                   flash, interpret):
     n_chunks = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     shift = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
+    has_mask = kv_mask is not None
+    m0 = kv_mask.astype(jnp.float32) if has_mask else None
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
     )
 
-    def chunk_bwd(k_cur, v_cur, src):
+    def chunk_bwd(k_cur, v_cur, m_cur, src):
+        mask = (m_cur > 0.0) if has_mask else None
         if flash:
             return _chunk_bwd_flash(
-                q, k_cur, v_cur, g, lse, delta, scale, causal, idx, src,
+                q, k_cur, v_cur, mask, g, lse, delta, scale, causal, idx, src,
                 interpret,
             )
         return _chunk_bwd_xla(
-            q, k_cur, v_cur, g, lse, delta, scale, causal, idx, src
+            q, k_cur, v_cur, mask, g, lse, delta, scale, causal, idx, src
         )
 
     dq0 = jnp.zeros(q.shape, jnp.float32)
@@ -269,50 +317,74 @@ def _ring_bwd_impl(q, k, v, out, lse, g, axis_name, causal, scale, flash,
 
     dq0, dk0, dv0 = pvary_like((dq0, dk0, dv0), q)
 
-    def accumulate(carry, step):
+    def unpack(carry):
+        if has_mask:
+            return carry
         k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        return k_cur, v_cur, None, dk_cur, dv_cur, dq
+
+    def accumulate(carry, step):
+        k_cur, v_cur, m_cur, dk_cur, dv_cur, dq = unpack(carry)
         src = (idx - step) % n_chunks
-        dq_i, dk_i, dv_i = chunk_bwd(k_cur, v_cur, src)
+        dq_i, dk_i, dv_i = chunk_bwd(k_cur, v_cur, m_cur, src)
         # dK/dV accumulators travel WITH their chunk: after the full
         # rotation (n_chunks steps) they arrive back at the chunk's owner
-        return k_cur, v_cur, dk_cur + dk_i, dv_cur + dv_i, dq + dq_i
+        return k_cur, v_cur, m_cur, dk_cur + dk_i, dv_cur + dv_i, dq + dq_i
 
     def body(carry, step):
-        k_cur, v_cur, dk_cur, dv_cur, dq = accumulate(carry, step)
+        k_cur, v_cur, m_cur, dk_cur, dv_cur, dq = accumulate(carry, step)
         k_cur = lax.ppermute(k_cur, axis_name, shift)
         v_cur = lax.ppermute(v_cur, axis_name, shift)
         dk_cur = lax.ppermute(dk_cur, axis_name, shift)
         dv_cur = lax.ppermute(dv_cur, axis_name, shift)
+        if has_mask:
+            m_cur = lax.ppermute(m_cur, axis_name, shift)
+            return (k_cur, v_cur, m_cur, dk_cur, dv_cur, dq), None
         return (k_cur, v_cur, dk_cur, dv_cur, dq), None
 
-    carry = (k, v, dk0, dv0, dq0)
+    carry = (k, v, m0, dk0, dv0, dq0) if has_mask else (k, v, dk0, dv0, dq0)
     if n_chunks > 1:
         # last step outside the scan: the K/V shards are done after it, so
         # only the dK/dV accumulators take the final homeward transfer
         carry, _ = lax.scan(body, carry, jnp.arange(n_chunks - 1))
-    _, _, dk, dv, dq = accumulate(carry, n_chunks - 1)
+    _, _, _, dk, dv, dq = accumulate(carry, n_chunks - 1)
     if n_chunks > 1:
         dk = lax.ppermute(dk, axis_name, shift)
         dv = lax.ppermute(dv, axis_name, shift)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring(q, k, v, axis_name, causal, scale, flash, interpret):
-    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal, scale, flash, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ring(q, k, v, kv_mask, axis_name, causal, scale, flash, interpret):
+    out, _ = _ring_fwd_impl(
+        q, k, v, kv_mask, axis_name, causal, scale, flash, interpret
+    )
     return out
 
 
-def _ring_fwd(q, k, v, axis_name, causal, scale, flash, interpret):
-    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal, scale, flash, interpret)
-    return out, (q, k, v, out, lse)
+def _ring_fwd(q, k, v, kv_mask, axis_name, causal, scale, flash, interpret):
+    out, lse = _ring_fwd_impl(
+        q, k, v, kv_mask, axis_name, causal, scale, flash, interpret
+    )
+    return out, (q, k, v, kv_mask, out, lse)
 
 
 def _ring_bwd(axis_name, causal, scale, flash, interpret, residuals, g):
-    q, k, v, out, lse = residuals
-    return _ring_bwd_impl(
-        q, k, v, out, lse, g, axis_name, causal, scale, flash, interpret
+    import numpy as np
+
+    q, k, v, kv_mask, out, lse = residuals
+    dq, dk, dv = _ring_bwd_impl(
+        q, k, v, kv_mask, out, lse, g, axis_name, causal, scale, flash,
+        interpret,
     )
+    dmask = None
+    if kv_mask is not None:
+        dmask = (
+            np.zeros(kv_mask.shape, dtype=jax.dtypes.float0)
+            if not jnp.issubdtype(kv_mask.dtype, jnp.floating)
+            else jnp.zeros_like(kv_mask)
+        )
+    return dq, dk, dv, dmask
 
 
 _ring.defvjp(_ring_fwd, _ring_bwd)
@@ -337,6 +409,7 @@ def ring_attention(
     v: jax.Array,
     axis_name: str,
     *,
+    kv_mask: Optional[jax.Array] = None,
     causal: bool = False,
     softmax_scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
@@ -347,6 +420,11 @@ def ring_attention(
     Args:
       q, k, v: local shards (batch, seq_local, heads, head_dim), sharded on
         the sequence dimension over ``axis_name``.
+      kv_mask: optional (batch, seq_local) key-padding validity shard
+        (True=attend), sharded on the sequence dim like k/v — what real
+        padded BERT batches need. The mask chunk rotates around the ring
+        with its k/v chunk and streams through the flash kernel's kv_mask
+        port; fully-padded rows produce zero output and zero gradients.
       causal: global causal masking — positions are reconstructed from the
         ring index, so the mask is exact across shard boundaries.
       use_flash: None = auto (Pallas local folds on TPU when shapes allow),
@@ -357,6 +435,11 @@ def ring_attention(
     """
     if softmax_scale is None:
         softmax_scale = q.shape[-1] ** -0.5
+    if kv_mask is not None and kv_mask.shape != (q.shape[0], k.shape[1]):
+        raise ValueError(
+            f"kv_mask shape {kv_mask.shape} != (batch, seq_local) "
+            f"({q.shape[0]}, {k.shape[1]})"
+        )
     if use_flash is None:
         flash = _flash_viable(q, flash_interpret)
     else:
@@ -368,7 +451,7 @@ def ring_attention(
                 f"{q.shape[-1]}, dtype {q.dtype})"
             )
     return _ring(
-        q, k, v, axis_name, causal, float(softmax_scale), flash,
+        q, k, v, kv_mask, axis_name, causal, float(softmax_scale), flash,
         flash_interpret,
     )
 
@@ -382,6 +465,7 @@ def ring_attention_sharded(
     seq_axis: str = "sequence",
     batch_axes: Sequence[str] = ("data", "fsdp"),
     heads_axis: str = "tensor",
+    kv_mask: Optional[jax.Array] = None,
     causal: bool = False,
     softmax_scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
@@ -394,6 +478,9 @@ def ring_attention_sharded(
     TP+SP runs each head group once instead of all-gathering heads and
     computing them redundantly per tensor replica. jit composes these specs
     with the surrounding program's shardings.
+
+    ``kv_mask``: optional GLOBAL (B, S) key-padding validity; sharded on
+    (batch, sequence) like k/v and rotated around the ring per shard.
     """
     batch_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
     heads = q.shape[2]
@@ -401,16 +488,23 @@ def ring_attention_sharded(
         mesh.shape.get(heads_axis, 1) > 1 and heads % mesh.shape[heads_axis] == 0
     )
     spec = P(batch_axes, seq_axis, heads_axis if use_heads_axis else None, None)
+    kernel = functools.partial(
+        ring_attention,
+        axis_name=seq_axis,
+        causal=causal,
+        softmax_scale=softmax_scale,
+        use_flash=use_flash,
+    )
+    if kv_mask is None:
+        fn = jax.shard_map(
+            kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )
+        return fn(q, k, v)
+    mask_spec = P(batch_axes, seq_axis)
     fn = jax.shard_map(
-        functools.partial(
-            ring_attention,
-            axis_name=seq_axis,
-            causal=causal,
-            softmax_scale=softmax_scale,
-            use_flash=use_flash,
-        ),
+        lambda q, k, v, m: kernel(q, k, v, kv_mask=m),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, mask_spec),
         out_specs=spec,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, kv_mask)

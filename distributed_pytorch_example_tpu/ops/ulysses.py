@@ -18,7 +18,13 @@ Trade-offs vs ring:
   keeps O(S_local) always) — Ulysses scales sequence length only until
   S x N/P activations fit;
 - constraint: the head count must divide by the axis size (ring has no
-  such constraint).
+  such constraint);
+- GQA memory caveat: when ``kv_heads < axis_size``, K/V are replicated up
+  to the axis size before the all-to-all (``P / kv_heads``x more KV memory
+  per device) — at ``sequence=8`` over 2 kv heads that is 4x, on the path
+  whose purpose is memory scaling. A trace-time warning fires when this
+  multiplier kicks in; keep ``kv_heads >= sequence-axis size`` (or shrink
+  the axis) to avoid it.
 
 Both compose with the same mesh axes; ``MultiHeadAttention`` selects via
 ``sp_mode``. The all-to-alls are reverse-mode differentiable (their
@@ -36,6 +42,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_pytorch_example_tpu.ops.attention import dot_product_attention
 
+# one warning per distinct (kv_heads, axis_size), not per layer per trace
+_warned_gqa_replication: set = set()
+
 
 def ulysses_attention(
     q: jax.Array,
@@ -43,6 +52,7 @@ def ulysses_attention(
     v: jax.Array,
     axis_name: str,
     *,
+    kv_mask: Optional[jax.Array] = None,
     causal: bool = False,
     softmax_scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
@@ -53,6 +63,12 @@ def ulysses_attention(
       q, k, v: local shards (batch, seq_local, heads, head_dim), sharded on
         the sequence dim over ``axis_name``. ``heads`` must divide by the
         axis size.
+      kv_mask: optional (batch, seq_local) key-padding validity shard
+        (True=attend). After the heads<->sequence all-to-all each device
+        attends over the FULL sequence, so the mask is all-gathered along
+        the axis (it is S bits per row — negligible next to the k/v
+        all-to-alls) and streams through the attention kernel's kv_mask
+        port.
 
     Returns the local output shard (batch, seq_local, heads, head_dim).
     """
@@ -78,6 +94,18 @@ def ulysses_attention(
         # the axis size (each q-head group still sees its correct kv head
         # — the group mapping is preserved under the replication)
         rep = p // kv_heads
+        from distributed_pytorch_example_tpu.runtime.logging import get_logger
+
+        key = (kv_heads, p)
+        if key not in _warned_gqa_replication:
+            _warned_gqa_replication.add(key)
+            get_logger(__name__).warning(
+                "Ulysses GQA: %d kv heads < sequence axis size %d — K/V "
+                "replicated %dx per device (that much MORE KV memory on "
+                "the path meant to scale memory); keep kv_heads >= the "
+                "sequence axis size to avoid this",
+                kv_heads, p, rep,
+            )
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
 
@@ -93,9 +121,17 @@ def ulysses_attention(
             x, axis_name, split_axis=1, concat_axis=2, tiled=True
         )
 
+    mask_full = None
+    if kv_mask is not None:
+        # heads are sharded after the swap but keys span the full sequence:
+        # every device needs the whole mask
+        mask_full = lax.all_gather(
+            kv_mask.astype(jnp.float32), axis_name, axis=1, tiled=True
+        ) > 0.0
     out = dot_product_attention(
         to_heads(q), to_heads(k), to_heads(v),
-        causal=causal, softmax_scale=softmax_scale, use_flash=use_flash,
+        kv_mask=mask_full, causal=causal, softmax_scale=softmax_scale,
+        use_flash=use_flash,
     )
     return to_seq(out)
 
@@ -109,6 +145,7 @@ def ulysses_attention_sharded(
     seq_axis: str = "sequence",
     batch_axes: Sequence[str] = ("data", "fsdp"),
     heads_axis: str = "tensor",
+    kv_mask: Optional[jax.Array] = None,
     causal: bool = False,
     softmax_scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
@@ -122,7 +159,14 @@ def ulysses_attention_sharded(
     batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
     tp = mesh.shape.get(heads_axis, 1)
     heads = q.shape[2]
-    seq_size = mesh.shape[seq_axis]
+    seq_size = mesh.shape.get(seq_axis)
+    if seq_size is None:
+        raise ValueError(
+            f"mesh {dict(mesh.shape)} has no {seq_axis!r} axis to run "
+            f"Ulysses sequence parallelism over; build the mesh with a "
+            f"sequence span (MeshSpec(sequence=...)) or call the dense "
+            f"attention path instead"
+        )
 
     def _local_kv_ok() -> bool:
         lkv = k.shape[2] // tp  # kv heads per tensor shard
@@ -136,16 +180,23 @@ def ulysses_attention_sharded(
         and _local_kv_ok()
     )
     spec = P(batch, seq_axis, heads_axis if use_heads_axis else None, None)
+    kernel = functools.partial(
+        ulysses_attention,
+        axis_name=seq_axis,
+        causal=causal,
+        softmax_scale=softmax_scale,
+        use_flash=use_flash,
+    )
+    if kv_mask is None:
+        fn = jax.shard_map(
+            kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )
+        return fn(q, k, v)
+    mask_spec = P(batch, seq_axis)
     fn = jax.shard_map(
-        functools.partial(
-            ulysses_attention,
-            axis_name=seq_axis,
-            causal=causal,
-            softmax_scale=softmax_scale,
-            use_flash=use_flash,
-        ),
+        lambda q, k, v, m: kernel(q, k, v, kv_mask=m),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, mask_spec),
         out_specs=spec,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, kv_mask)

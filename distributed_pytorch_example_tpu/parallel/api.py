@@ -94,6 +94,7 @@ class Partitioner:
         self.mesh = mesh
         self.rules = [(re.compile(pattern), spec) for pattern, spec in rules]
         self.default = default
+        self._warned_fallbacks: set = set()  # one line per distinct cause
 
     def _fits(self, spec: P, shape: Tuple[int, ...]) -> bool:
         """Whether ``spec`` is applicable to a leaf of this shape.
@@ -122,10 +123,43 @@ class Partitioner:
                 s = spec(shape) if callable(spec) else spec
                 if self._fits(s, shape):
                     return s
-                break  # matched rule unfit for this rank/shape: use default
+                # matched rule unfit for this rank/shape: fall back, but
+                # say so — this is right for adafactor's rank-1 factored
+                # stats under rank-2 param paths, and a misconfiguration
+                # signal everywhere else (e.g. tensor axis > head dim)
+                self._warn_fallback(path, s, shape, "rule")
+                break
         d = self.default
         s = d(shape) if callable(d) else d
-        return s if self._fits(s, shape) else P()
+        if self._fits(s, shape):
+            return s
+        if s != P():
+            self._warn_fallback(path, s, shape, "default")
+        return P()
+
+    def _warn_fallback(self, path, spec, shape, kind: str) -> None:
+        from distributed_pytorch_example_tpu.runtime.logging import get_logger
+
+        log = get_logger(__name__)
+        if len(spec) > len(shape):
+            # the expected case: optax state reusing a param path at lower
+            # rank (adafactor's factored v_row/v_col) — visible, not noisy
+            log.debug(
+                "partitioner: %s spec %s outranks %s at %r — replicated",
+                kind, spec, shape, path,
+            )
+            return
+        key = (kind, str(spec), shape)
+        if key in self._warned_fallbacks:
+            return
+        self._warned_fallbacks.add(key)
+        log.warning(
+            "partitioner: %s spec %s does not divide %s (e.g. at %r) — "
+            "such leaves fall back to %s (replication); check the mesh "
+            "axis sizes if this is unexpected",
+            kind, spec, shape, path,
+            "the default" if kind == "rule" else "P()",
+        )
 
     def tree_specs(self, tree: Any) -> Any:
         """PartitionSpec per leaf (tree may hold arrays or ShapeDtypeStructs)."""

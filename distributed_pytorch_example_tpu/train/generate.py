@@ -122,6 +122,14 @@ def generate(
         # top_p == 0 would wrap the nucleus cut index to -1 and silently
         # disable truncation — the opposite of the caller's intent
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k is not None:
+        # lax.top_k fails at trace time with an obscure error for k < 1 or
+        # k > vocab; validate here where the message can name the flag
+        vocab = getattr(model, "vocab_size", None)
+        if top_k < 1 or (vocab is not None and top_k > vocab):
+            raise ValueError(
+                f"top_k must be in [1, vocab_size={vocab}], got {top_k}"
+            )
     if not getattr(model, "decode", False):
         raise ValueError(
             "generate() needs a decode-mode model: construct it with "

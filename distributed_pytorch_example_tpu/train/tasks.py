@@ -22,6 +22,13 @@ import optax
 Metrics = Dict[str, jax.Array]
 
 
+def _fused_head(model) -> bool:
+    """True when the model returns hidden states for the fused chunked-CE
+    loss (``logits_mode='hidden'`` + ``head_params``, see ops/chunked_ce.py)
+    instead of materialized (B, S, V) logits."""
+    return getattr(model, "logits_mode", "full") == "hidden"
+
+
 def _apply_model(model, params, model_state, inputs, rng, train: bool):
     """Run model.apply handling mutable collections + dropout rng.
 
@@ -82,10 +89,23 @@ class CausalLMTask:
         self, model, params, model_state, batch, rng, *, train: bool
     ) -> Tuple[jax.Array, Metrics, Any]:
         tokens = batch["tokens"]
-        logits, new_ms, aux = _apply_model(
+        out, new_ms, aux = _apply_model(
             model, params, model_state, tokens, rng, train
         )
-        logits, targets = logits[:, :-1], tokens[:, 1:]
+        targets = tokens[:, 1:]
+        if _fused_head(model):
+            from distributed_pytorch_example_tpu.ops.chunked_ce import (
+                chunked_softmax_xent,
+            )
+
+            embedding, bias = type(model).head_params(params)
+            per_tok, argmax = chunked_softmax_xent(
+                out[:, :-1], embedding, targets, bias=bias, dtype=model.dtype
+            )
+            loss = per_tok.mean() + aux
+            accuracy = 100.0 * jnp.mean(argmax == targets)
+            return loss, {"loss": loss, "accuracy": accuracy}, new_ms
+        logits = out[:, :-1]
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), targets
         ).mean() + aux
@@ -146,14 +166,27 @@ class MLMTask:
             jnp.asarray(self.mask_token_id, tokens.dtype),
             jnp.where(selected & (kind >= 0.9), random_tokens, tokens),
         )
-        logits, new_ms, aux = _apply_model(
+        out, new_ms, aux = _apply_model(
             model, params, model_state, masked_inputs, rng_drop, train
         )
-        per_tok = optax.softmax_cross_entropy_with_integer_labels(
-            logits.astype(jnp.float32), tokens
-        )
         denom = jnp.maximum(selected.sum(), 1)
+        if _fused_head(model):
+            from distributed_pytorch_example_tpu.ops.chunked_ce import (
+                chunked_softmax_xent,
+            )
+
+            embedding, bias = type(model).head_params(params)
+            per_tok, argmax = chunked_softmax_xent(
+                out, embedding, tokens, bias=bias, dtype=model.dtype
+            )
+            loss = jnp.where(selected, per_tok, 0.0).sum() / denom + aux
+            correct = jnp.where(selected, argmax == tokens, False)
+            accuracy = 100.0 * correct.sum() / denom
+            return loss, {"loss": loss, "accuracy": accuracy}, new_ms
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            out.astype(jnp.float32), tokens
+        )
         loss = jnp.where(selected, per_tok, 0.0).sum() / denom + aux
-        correct = jnp.where(selected, jnp.argmax(logits, axis=-1) == tokens, False)
+        correct = jnp.where(selected, jnp.argmax(out, axis=-1) == tokens, False)
         accuracy = 100.0 * correct.sum() / denom
         return loss, {"loss": loss, "accuracy": accuracy}, new_ms

@@ -74,6 +74,11 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         "other transformer block (gpt2)")
     parser.add_argument("--moe-top-k", type=int, default=1,
                         help="experts per token (1 = Switch, 2 = GShard)")
+    parser.add_argument("--lm-loss", type=str, default="fused",
+                        choices=("fused", "dense"),
+                        help="LM-head loss path: fused = chunked vocab "
+                        "cross-entropy, no materialized (B,S,V) f32 logits "
+                        "(ops/chunked_ce.py); dense = full logits + optax CE")
     parser.add_argument("--partition", type=str, default="dp",
                         help="dp|fsdp|tp (tp uses per-model transformer rules)")
     parser.add_argument("--dtype", type=str, default="float32",

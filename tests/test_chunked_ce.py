@@ -1,0 +1,123 @@
+"""Chunked vocab-blockwise cross-entropy vs the dense reference path.
+
+Pins the fused LM loss (ops/chunked_ce.py) to the semantics of the dense
+``tied_head_logits -> optax.softmax_cross_entropy_with_integer_labels``
+pipeline it replaces (the reference's ``nn.CrossEntropyLoss``, reference
+train.py:250): values, argmax, and gradients w.r.t. hidden states,
+embedding, and bias.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_pytorch_example_tpu.ops.chunked_ce import chunked_softmax_xent
+
+
+def _dense(hidden, embedding, targets, bias=None, dtype=jnp.bfloat16):
+    logits = jax.lax.dot_general(
+        hidden.astype(dtype), embedding.astype(dtype),
+        (((hidden.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    return loss, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@pytest.mark.parametrize("vocab,block", [(1000, 256), (1000, 1000), (777, 128)])
+@pytest.mark.parametrize("bias", [False, True])
+def test_matches_dense(vocab, block, bias):
+    k = jax.random.PRNGKey(0)
+    kx, ke, kt, kb = jax.random.split(k, 4)
+    hidden = jax.random.normal(kx, (4, 9, 32), jnp.float32)
+    embedding = jax.random.normal(ke, (vocab, 32), jnp.float32) * 0.1
+    targets = jax.random.randint(kt, (4, 9), 0, vocab)
+    b = jax.random.normal(kb, (vocab,)) * 0.1 if bias else None
+
+    ref_loss, ref_argmax = _dense(hidden, embedding, targets, b)
+    loss, argmax = chunked_softmax_xent(
+        hidden, embedding, targets, bias=b, block_size=block
+    )
+    np.testing.assert_allclose(loss, ref_loss, rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(argmax, ref_argmax)
+
+
+@pytest.mark.parametrize("bias", [False, True])
+def test_grads_match_dense(bias):
+    vocab, dim = 500, 16
+    k = jax.random.PRNGKey(1)
+    kx, ke, kt, kb = jax.random.split(k, 4)
+    hidden = jax.random.normal(kx, (3, 7, dim), jnp.float32)
+    embedding = jax.random.normal(ke, (vocab, dim)) * 0.1
+    targets = jax.random.randint(kt, (3, 7), 0, vocab)
+    b = jax.random.normal(kb, (vocab,)) * 0.1 if bias else None
+
+    def loss_chunked(h, e, bb):
+        loss, _ = chunked_softmax_xent(
+            h, e, targets, bias=bb, block_size=128
+        )
+        return loss.mean()
+
+    def loss_dense(h, e, bb):
+        loss, _ = _dense(h, e, targets, bb)
+        return loss.mean()
+
+    args = (hidden, embedding, b) if bias else (hidden, embedding, None)
+    argnums = (0, 1, 2) if bias else (0, 1)
+    g_chunk = jax.grad(loss_chunked, argnums=argnums)(*args)
+    g_dense = jax.grad(loss_dense, argnums=argnums)(*args)
+    for gc, gd in zip(g_chunk, g_dense):
+        # both sides do bf16 matmuls; backward orders differ slightly
+        np.testing.assert_allclose(gc, gd, rtol=6e-3, atol=6e-5)
+
+
+def test_bf16_hidden_states():
+    """bf16 hidden states (the model's compute dtype) round-trip cleanly."""
+    vocab, dim = 300, 24
+    k = jax.random.PRNGKey(2)
+    kx, ke, kt = jax.random.split(k, 3)
+    hidden = jax.random.normal(kx, (2, 5, dim), jnp.bfloat16)
+    embedding = jax.random.normal(ke, (vocab, dim)) * 0.1
+    targets = jax.random.randint(kt, (2, 5), 0, vocab)
+    ref_loss, ref_argmax = _dense(hidden, embedding, targets)
+    loss, argmax = chunked_softmax_xent(
+        hidden, embedding, targets, block_size=128
+    )
+    np.testing.assert_allclose(loss, ref_loss, rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(argmax, ref_argmax)
+
+    def f(h, e):
+        l, _ = chunked_softmax_xent(h, e, targets, block_size=128)
+        return l.mean()
+
+    gh, ge = jax.grad(f, argnums=(0, 1))(hidden, embedding)
+    assert gh.dtype == jnp.bfloat16 and ge.dtype == embedding.dtype
+
+
+def test_argmax_tie_breaks_first():
+    """Duplicate embedding rows: argmax picks the lowest id, like dense."""
+    dim = 8
+    emb_row = jnp.ones((1, dim))
+    embedding = jnp.concatenate([emb_row] * 6, axis=0)  # all identical
+    hidden = jnp.ones((1, 1, dim))
+    targets = jnp.zeros((1, 1), jnp.int32)
+    _, argmax = chunked_softmax_xent(
+        hidden, embedding, targets, block_size=2
+    )
+    assert int(argmax[0, 0]) == 0
+
+
+def test_shape_validation():
+    hidden = jnp.zeros((2, 3, 8))
+    embedding = jnp.zeros((10, 9))
+    targets = jnp.zeros((2, 3), jnp.int32)
+    with pytest.raises(ValueError, match="hidden dim"):
+        chunked_softmax_xent(hidden, embedding, targets)
+    with pytest.raises(ValueError, match="targets shape"):
+        chunked_softmax_xent(
+            jnp.zeros((2, 3, 9)), embedding, jnp.zeros((2, 4), jnp.int32)
+        )

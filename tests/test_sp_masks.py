@@ -1,0 +1,159 @@
+"""Key-padding masks through BOTH sequence-parallel modes (VERDICT r2 #2).
+
+Ring: the (B, S_chunk) mask chunk rotates around the ring with its K/V
+chunk and feeds the flash kernel's kv_mask port. Ulysses: the mask is
+all-gathered after the heads<->sequence all-to-all. Both must match the
+dense masked XLA reference — values and gradients — and BERT with
+--pad-token-id must train under a sequence-spanning mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_example_tpu.ops.attention import _xla_attention
+from distributed_pytorch_example_tpu.ops.ring_attention import (
+    ring_attention_sharded,
+)
+from distributed_pytorch_example_tpu.ops.ulysses import (
+    ulysses_attention_sharded,
+)
+from distributed_pytorch_example_tpu.runtime import MeshSpec, make_mesh
+
+
+def make_qkv(batch=2, seq=256, heads=4, head_dim=32, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (batch, seq, heads, head_dim)
+    return tuple(
+        jnp.asarray(rng.standard_normal(shape), jnp.float32) for _ in range(3)
+    )
+
+
+def make_mask(batch=2, seq=256, seed=1):
+    """Realistic padding: each row valid up to a random length (>= 1)."""
+    rng = np.random.default_rng(seed)
+    lengths = rng.integers(1, seq + 1, size=(batch,))
+    return jnp.asarray(np.arange(seq)[None, :] < lengths[:, None])
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_masked_matches_dense(devices, causal):
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    q, k, v = make_qkv()
+    mask = make_mask()
+    scale = q.shape[-1] ** -0.5
+    expected = _xla_attention(q, k, v, None, mask, causal, scale)
+    got = ring_attention_sharded(
+        q, k, v, mesh, kv_mask=mask, causal=causal
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_masked_matches_dense(devices, causal):
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    q, k, v = make_qkv()
+    mask = make_mask()
+    scale = q.shape[-1] ** -0.5
+    expected = _xla_attention(q, k, v, None, mask, causal, scale)
+    got = ulysses_attention_sharded(
+        q, k, v, mesh, kv_mask=mask, causal=causal
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_masked_grads_match_dense(devices, mode):
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    q, k, v = make_qkv(seq=128)
+    mask = make_mask(seq=128)
+    scale = q.shape[-1] ** -0.5
+    sharded = (
+        ring_attention_sharded if mode == "ring" else ulysses_attention_sharded
+    )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, None, mask, False, scale) ** 2)
+
+    def loss_sp(q, k, v):
+        return jnp.sum(sharded(q, k, v, mesh, kv_mask=mask) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_sp = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
+    for gr, gg, name in zip(g_ref, g_sp, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gr), atol=1e-4, err_msg=f"d{name}"
+        )
+
+
+def test_ring_fully_padded_row(devices):
+    """A row with every key masked: zero output, zero grads, no NaNs."""
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    q, k, v = make_qkv(seq=128)
+    mask = make_mask(seq=128)
+    mask = mask.at[0].set(False)  # row 0: nothing to attend to
+
+    def loss(q, k, v):
+        return jnp.sum(
+            ring_attention_sharded(q, k, v, mesh, kv_mask=mask) ** 2
+        )
+
+    out = ring_attention_sharded(q, k, v, mesh, kv_mask=mask)
+    assert np.all(np.isfinite(np.asarray(out)))
+    np.testing.assert_array_equal(np.asarray(out[0]), 0.0)
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in (gq, gk, gv):
+        assert np.all(np.isfinite(np.asarray(g)))
+        np.testing.assert_array_equal(np.asarray(g[0]), 0.0)
+
+
+@pytest.mark.parametrize("sp_mode", ["ring", "ulysses"])
+def test_bert_pad_token_trains_under_sp_mesh(devices, sp_mode):
+    """BERT + --pad-token-id + mesh sequence=2: the combination VERDICT r2
+    flagged as refused; one full fused-loss train step must run and the
+    masked loss must match the same model on a no-sequence mesh."""
+    import optax
+
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.train.tasks import MLMTask
+
+    vocab, seq = 97, 32
+    kwargs = dict(
+        vocab_size=vocab, max_len=seq, model_dim=32, num_layers=2,
+        num_heads=4, mlp_dim=64, dtype=jnp.float32, use_flash=False,
+        pad_token_id=0,
+    )
+    rng = np.random.default_rng(0)
+    tokens_np = rng.integers(1, vocab, (8, seq)).astype(np.int32)
+    tokens_np[:, seq - 6:] = 0  # pad tail
+    task = MLMTask(vocab_size=vocab, mask_token_id=3, pad_token_id=0)
+
+    losses = {}
+    for spec, seq_axis in (
+        (MeshSpec(data=4, sequence=2), "sequence"),
+        (MeshSpec(data=8), None),
+    ):
+        mesh = make_mesh(spec)
+        model = dpx.models.get_model(
+            "bert", seq_axis=seq_axis,
+            sp_mode=sp_mode if seq_axis else "ring", **kwargs
+        )
+        trainer = dpx.train.Trainer(
+            model, task, optax.adam(1e-3),
+            partitioner=dpx.parallel.data_parallel(mesh),
+        )
+        sharding = trainer.partitioner.batch_sharding()
+        batch = {
+            "tokens": jax.make_array_from_process_local_data(
+                sharding, tokens_np
+            )
+        }
+        with mesh:
+            trainer.init(batch["tokens"])
+            _, metrics = trainer.train_step(trainer.state, batch)
+            losses[seq_axis] = float(metrics["loss"])
+    assert np.isfinite(losses["sequence"])
+    np.testing.assert_allclose(
+        losses["sequence"], losses[None], rtol=1e-4
+    )

@@ -199,14 +199,17 @@ def main():
             overrides["seq_axis"] = "sequence"  # SP over the mesh
             if args.sp_mode is not None:  # None: keep the model's default
                 overrides["sp_mode"] = args.sp_mode
+    if args.model.startswith(("bert", "gpt", "llama")) and args.lm_loss == "fused":
+        # fused chunked-CE loss: the model returns final hidden states and
+        # the task streams the tied-head matmul + softmax over vocab blocks
+        overrides["logits_mode"] = "hidden"
     if args.pad_token_id is not None:
         if not args.model.startswith("bert"):
             parser.error(f"--pad-token-id is only supported for bert models, "
                          f"not {args.model!r}")
-        if args.mesh_sequence not in (0, 1):
-            parser.error("--pad-token-id cannot combine with --mesh-sequence "
-                         "> 1: the ring-attention path has no padding-mask "
-                         "support yet")
+        # composes with --mesh-sequence: the padding mask streams through
+        # both SP modes (ring rotates mask chunks with k/v; Ulysses
+        # all-gathers the mask after its head swap)
         overrides["pad_token_id"] = args.pad_token_id
     if args.moe_experts:
         if not args.model.startswith("gpt"):
