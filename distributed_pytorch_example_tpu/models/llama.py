@@ -122,6 +122,8 @@ class Llama(nn.Module):
     sp_mode: str = "ulysses"
     decode: bool = False
     remat: bool = False
+    pipe_axis: Optional[str] = None  # mesh axis for pipeline stages (PP)
+    pipe_microbatches: int = 0  # 0 = auto
     # "full": (B, S, V) logits. "hidden": final hidden states for the fused
     # chunked-CE loss (train/tasks.py + ``head_params``).
     logits_mode: str = "full"
@@ -142,6 +144,18 @@ class Llama(nn.Module):
             )
         if self.decode and self.logits_mode != "full":
             raise ValueError("decode mode requires logits_mode='full'")
+        if self.pipe_axis is not None and self.seq_axis:
+            raise ValueError(
+                "pipe_axis cannot combine with seq_axis yet (the pipeline "
+                "stages are whole-sequence dense blocks)"
+            )
+        if self.pipe_axis is not None and self.decode:
+            raise ValueError(
+                "decode (KV-cache generation) is not supported on the "
+                "pipelined path; construct the decode model without "
+                "pipe_axis (params are layout-incompatible with the "
+                "stacked decoder anyway)"
+            )
         # tokens: (B, S) int32 → logits (B, S, vocab); positions come from
         # RoPE inside attention — no learned position table
         x = nn.Embed(
@@ -150,6 +164,29 @@ class Llama(nn.Module):
             embedding_init=nn.initializers.normal(stddev=0.02),
             name="tok_embed",
         )(tokens).astype(self.dtype)
+
+        if self.pipe_axis is not None:
+            from distributed_pytorch_example_tpu.models.stacked import (
+                StackedLlamaDecoder,
+            )
+
+            x = StackedLlamaDecoder(
+                num_layers=self.num_layers,
+                num_heads=self.num_heads,
+                num_kv_heads=self.num_kv_heads,
+                head_dim=self.model_dim // self.num_heads,
+                model_dim=self.model_dim,
+                mlp_dim=self.mlp_dim,
+                rope_theta=self.rope_theta,
+                layer_norm_epsilon=1e-5,
+                dtype=self.dtype,
+                use_flash=self.use_flash,
+                remat=self.remat,
+                pipe_axis=self.pipe_axis,
+                pipe_microbatches=self.pipe_microbatches,
+                name="decoder",
+            )(x, train=train)
+            return self._head(x)
 
         for i in range(self.num_layers):
             block = LlamaBlock(
@@ -173,6 +210,9 @@ class Llama(nn.Module):
                 )(block, x)
             else:
                 x = block(x, train=train)
+        return self._head(x)
+
+    def _head(self, x):
         x = RMSNorm(1e-5, self.dtype, name="final_ln")(x)
         # untied head; bf16 operands with float32 accumulation — same
         # stable-softmax convention as tied_head_logits (transformer.py)

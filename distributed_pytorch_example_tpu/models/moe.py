@@ -109,6 +109,18 @@ class MoEMlpBlock(nn.Module):
             dispatch_k * gates[..., None, None], axis=2
         )  # weighted return path
 
+        # observability: capacity-dropped (token, choice) pairs ride the
+        # residual silently — surface the fraction so a mis-tuned
+        # capacity_factor shows up in metrics (train/tasks.py averages the
+        # sown values into `moe_dropped_fraction`), not as mysterious loss
+        # degradation
+        if not self.is_initializing():  # init must not bake a stale value
+            kept = jnp.sum(dispatch)  # each kept pair contributes exactly 1
+            self.sow(
+                "moe_metrics", "dropped_fraction",
+                1.0 - kept / (batch * seq * k),
+            )
+
         # expert weights: leading expert dim is the EP sharding target
         w_up = self.param(
             "up_kernel",

@@ -49,6 +49,73 @@ def _layer_norm(x, scale, bias, eps, dtype):
     return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
 
 
+def _rms_norm(x, scale, eps, dtype):
+    """RMSNorm with float32 statistics (models/llama.py semantics)."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + eps) * scale.astype(jnp.float32)).astype(dtype)
+
+
+def _pipe_size(pipe_axis) -> int:
+    """Pipeline span of the active mesh (0/1 = run sequentially)."""
+    if pipe_axis is None:
+        return 1
+    from distributed_pytorch_example_tpu.runtime.mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None:
+        raise RuntimeError(
+            f"pipe_axis={pipe_axis!r} requires an active `with mesh:` "
+            "context (Trainer enters it automatically; wrap manual "
+            "apply() calls yourself)."
+        )
+    return mesh.shape.get(pipe_axis, 1)
+
+
+def _run_stacked(mod, params, x, block):
+    """Shared execution for layer-stacked decoders: scan or GPipe.
+
+    ``mod`` provides num_layers / dtype / remat / pipe_axis /
+    pipe_microbatches fields.
+    """
+    x = x.astype(mod.dtype)
+    if mod.remat:
+        block = jax.checkpoint(block, prevent_cse=False)
+    pipe = _pipe_size(mod.pipe_axis)
+    if pipe <= 1:
+        def body(h, lp):
+            return block(lp, h), None
+
+        out, _ = lax.scan(body, x, params)
+        return out
+
+    from distributed_pytorch_example_tpu.parallel.pipeline import gpipe
+    from distributed_pytorch_example_tpu.runtime.mesh import (
+        current_mesh,
+        data_parallel_size,
+    )
+
+    mesh = current_mesh()
+    L = mod.num_layers
+    if L % pipe:
+        raise ValueError(f"num_layers {L} not divisible by pipe size {pipe}")
+    n_micro = mod.pipe_microbatches or _auto_microbatches(
+        x.shape[0], pipe, data_parallel_size(mesh)
+    )
+    sp = jax.tree_util.tree_map(
+        lambda v: v.reshape(pipe, L // pipe, *v.shape[1:]), params
+    )
+
+    def stage_fn(stage_params, h):
+        def body(hh, lp):
+            return block(lp, hh), None
+
+        out, _ = lax.scan(body, h, stage_params)
+        return out
+
+    return gpipe(stage_fn, sp, x, mesh, n_micro, pipe_axis=mod.pipe_axis)
+
+
 class StackedDecoder(nn.Module):
     """Homogeneous pre-LN transformer blocks with layer-stacked params."""
 
@@ -94,69 +161,7 @@ class StackedDecoder(nn.Module):
             "down_bias": stacked("down_bias", zeros, (D,)),
         }
 
-        x = x.astype(self.dtype)
-        block = self._block_fn(x.shape)
-        if self.remat:
-            block = jax.checkpoint(block, prevent_cse=False)
-
-        pipe = self._pipe_size()
-        if pipe <= 1:
-            def body(h, lp):
-                return block(lp, h), None
-
-            out, _ = lax.scan(body, x, params)
-            return out
-        return self._pipelined(block, params, x, pipe)
-
-    # -- execution paths ----------------------------------------------------
-
-    def _pipe_size(self) -> int:
-        """Pipeline span of the active mesh (0/1 = run sequentially)."""
-        if self.pipe_axis is None:
-            return 1
-        from distributed_pytorch_example_tpu.runtime.mesh import current_mesh
-
-        mesh = current_mesh()
-        if mesh is None:
-            raise RuntimeError(
-                f"pipe_axis={self.pipe_axis!r} requires an active `with "
-                "mesh:` context (Trainer enters it automatically; wrap "
-                "manual apply() calls yourself)."
-            )
-        return mesh.shape.get(self.pipe_axis, 1)
-
-    def _pipelined(self, block, params, x, n_stages):
-        from distributed_pytorch_example_tpu.parallel.pipeline import gpipe
-        from distributed_pytorch_example_tpu.runtime.mesh import current_mesh
-
-        mesh = current_mesh()
-        L = self.num_layers
-        if L % n_stages:
-            raise ValueError(
-                f"num_layers {L} not divisible by pipe size {n_stages}"
-            )
-        from distributed_pytorch_example_tpu.runtime.mesh import (
-            data_parallel_size,
-        )
-
-        n_micro = self.pipe_microbatches or _auto_microbatches(
-            x.shape[0], n_stages, data_parallel_size(mesh)
-        )
-        sp = jax.tree_util.tree_map(
-            lambda v: v.reshape(n_stages, L // n_stages, *v.shape[1:]),
-            params,
-        )
-
-        def stage_fn(stage_params, h):
-            def body(hh, lp):
-                return block(lp, hh), None
-
-            out, _ = lax.scan(body, h, stage_params)
-            return out
-
-        return gpipe(
-            stage_fn, sp, x, mesh, n_micro, pipe_axis=self.pipe_axis
-        )
+        return _run_stacked(self, params, x, self._block_fn(x.shape))
 
     def _block_fn(self, x_shape):
         """(layer_params, h) -> h, pre-LN block in compute dtype."""
@@ -183,6 +188,99 @@ class StackedDecoder(nn.Module):
             b = _layer_norm(h, lp["ln2_scale"], lp["ln2_bias"], eps, dtype)
             mlp = dense(nn.gelu(dense(b, lp["up_kernel"], lp["up_bias"])),
                         lp["down_kernel"], lp["down_bias"])
+            return h + mlp
+
+        return block
+
+
+class StackedLlamaDecoder(nn.Module):
+    """Layer-stacked LLaMA-family blocks: RMSNorm + RoPE + GQA + SwiGLU.
+
+    The pipeline-capable twin of ``models/llama.py``'s per-layer blocks
+    (same math: pre-RMSNorm, rotary q/k, grouped-query attention, SwiGLU
+    MLP, no biases), with every weight stacked on a leading ``num_layers``
+    dim so ``--mesh-pipe`` serves the LLaMA family like it serves GPT-2.
+    Param names follow the stacked partition rules
+    (parallel/partition.py): ``(q|k|v|up|gate)_kernel`` column-parallel,
+    ``(o|down)_kernel`` row-parallel, ``ln[12]_scale`` replicated per
+    stage.
+    """
+
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    model_dim: int
+    mlp_dim: int
+    rope_theta: float = 10000.0
+    layer_norm_epsilon: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None
+    remat: bool = False
+    pipe_axis: Optional[str] = None
+    pipe_microbatches: int = 0
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        if self.num_heads % self.num_kv_heads:
+            raise ValueError(
+                f"num_heads {self.num_heads} not divisible by num_kv_heads "
+                f"{self.num_kv_heads}"
+            )
+        L, D, M = self.num_layers, self.model_dim, self.mlp_dim
+        F = self.num_heads * self.head_dim
+        KF = self.num_kv_heads * self.head_dim
+        lecun = nn.initializers.lecun_normal()
+        ones = nn.initializers.ones
+
+        def stacked(name, init, shape):
+            return self.param(name, init, (L, *shape))
+
+        params = {
+            "ln1_scale": stacked("ln1_scale", ones, (D,)),
+            "q_kernel": stacked("q_kernel", lecun, (D, F)),
+            "k_kernel": stacked("k_kernel", lecun, (D, KF)),
+            "v_kernel": stacked("v_kernel", lecun, (D, KF)),
+            "o_kernel": stacked("o_kernel", lecun, (F, D)),
+            "ln2_scale": stacked("ln2_scale", ones, (D,)),
+            "gate_kernel": stacked("gate_kernel", lecun, (D, M)),
+            "up_kernel": stacked("up_kernel", lecun, (D, M)),
+            "down_kernel": stacked("down_kernel", lecun, (M, D)),
+        }
+        return _run_stacked(self, params, x, self._block_fn(x.shape))
+
+    def _block_fn(self, x_shape):
+        """(layer_params, h) -> h; pre-RMSNorm GQA block, compute dtype."""
+        from distributed_pytorch_example_tpu.ops.rope import rope
+
+        seq = x_shape[1]
+        dtype = self.dtype
+        eps = self.layer_norm_epsilon
+        q_shape = (-1, seq, self.num_heads, self.head_dim)
+        kv_shape = (-1, seq, self.num_kv_heads, self.head_dim)
+        scale = 1.0 / math.sqrt(self.head_dim)
+        theta = self.rope_theta
+
+        def dense(z, kernel):
+            return z @ kernel.astype(dtype)
+
+        def block(lp, h):
+            a = _rms_norm(h, lp["ln1_scale"], eps, dtype)
+            q = dense(a, lp["q_kernel"]).reshape(q_shape)
+            k = dense(a, lp["k_kernel"]).reshape(kv_shape)
+            v = dense(a, lp["v_kernel"]).reshape(kv_shape)
+            q = rope(q, theta=theta)
+            k = rope(k, theta=theta)
+            attn = dot_product_attention(
+                q, k, v, causal=True, softmax_scale=scale,
+                use_flash=self.use_flash,
+            )
+            h = h + dense(attn.reshape(*h.shape[:-1], -1), lp["o_kernel"])
+            b = _rms_norm(h, lp["ln2_scale"], eps, dtype)
+            mlp = dense(
+                nn.silu(dense(b, lp["gate_kernel"])) * dense(b, lp["up_kernel"]),
+                lp["down_kernel"],
+            )
             return h + mlp
 
         return block

@@ -48,7 +48,7 @@ TRANSFORMER_TP_RULES: tuple = (
     # 'pipe' (pipeline stages), features on 'tensor' per the same Megatron
     # column/row split. Ordered after the moe rules: `up_kernel$` would
     # otherwise shadow `moe/up_kernel`.
-    (r"(q|k|v|up)_kernel$", P("pipe", None, "tensor")),
+    (r"(q|k|v|up|gate)_kernel$", P("pipe", None, "tensor")),
     (r"(q|k|v|up)_bias$", P("pipe", "tensor")),
     (r"(o|down)_kernel$", P("pipe", "tensor", None)),
     (r"(o|down)_bias$", P("pipe", None)),

@@ -259,6 +259,12 @@ class Trainer:
                 "val_loss": val_metrics.get("loss", float("nan")),
                 "val_accuracy": val_metrics.get("accuracy", float("nan")),
             }
+            # task-specific observability scalars (e.g. MoE
+            # moe_dropped_fraction) ride along under their own names
+            record.update({
+                f"train_{k}": v for k, v in train_metrics.items()
+                if k not in ("loss", "accuracy")
+            })
             if global_batch:
                 # training throughput only: validation time excluded
                 record["samples_per_sec"] = (

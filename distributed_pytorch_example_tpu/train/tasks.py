@@ -32,24 +32,32 @@ def _fused_head(model) -> bool:
 def _apply_model(model, params, model_state, inputs, rng, train: bool):
     """Run model.apply handling mutable collections + dropout rng.
 
-    Returns ``(logits, new_model_state, aux_loss)``. In train mode the
-    ``losses`` collection is requested so modules can contribute auxiliary
-    losses via ``self.sow("losses", ...)`` (e.g. MoE load balancing);
-    aux_loss is their sum and is NOT part of the carried model state.
+    Returns ``(logits, new_model_state, aux_loss, extra_metrics)``. In
+    train mode the ``losses`` collection is requested so modules can
+    contribute auxiliary losses via ``self.sow("losses", ...)`` (e.g. MoE
+    load balancing); aux_loss is their sum and is NOT part of the carried
+    model state. The ``moe_metrics`` collection carries observability
+    scalars (e.g. capacity-drop fractions), averaged across layers into
+    ``extra_metrics`` — reported, never added to the loss.
     """
     variables = {"params": params, **(model_state or {})}
     rngs = {"dropout": rng} if train else {}
     if train:
-        mutable = list(model_state.keys()) + ["losses"] if model_state else ["losses"]
+        mutable = list(model_state.keys()) if model_state else []
+        mutable += ["losses", "moe_metrics"]
         logits, new_vars = model.apply(
             variables, inputs, train=train, rngs=rngs, mutable=mutable
         )
         new_vars = dict(new_vars)
         losses = new_vars.pop("losses", {})
         aux = sum(jax.tree_util.tree_leaves(losses)) if losses else 0.0
-        return logits, (new_vars or (model_state or {})), aux
+        sown = jax.tree_util.tree_leaves(new_vars.pop("moe_metrics", {}))
+        extra = (
+            {"moe_dropped_fraction": sum(sown) / len(sown)} if sown else {}
+        )
+        return logits, (new_vars or (model_state or {})), aux, extra
     out = model.apply(variables, inputs, train=train, rngs=rngs, mutable=False)
-    return out, (model_state or {}), 0.0
+    return out, (model_state or {}), 0.0, {}
 
 
 class ClassificationTask:
@@ -64,7 +72,7 @@ class ClassificationTask:
     def compute_loss(
         self, model, params, model_state, batch, rng, *, train: bool
     ) -> Tuple[jax.Array, Metrics, Any]:
-        logits, new_ms, aux = _apply_model(
+        logits, new_ms, aux, extra = _apply_model(
             model, params, model_state, batch["x"], rng, train
         )
         labels = batch["y"]
@@ -72,7 +80,7 @@ class ClassificationTask:
             logits.astype(jnp.float32), labels
         ).mean() + aux
         accuracy = 100.0 * jnp.mean(jnp.argmax(logits, axis=-1) == labels)
-        return loss, {"loss": loss, "accuracy": accuracy}, new_ms
+        return loss, {"loss": loss, "accuracy": accuracy, **extra}, new_ms
 
 
 class CausalLMTask:
@@ -89,7 +97,7 @@ class CausalLMTask:
         self, model, params, model_state, batch, rng, *, train: bool
     ) -> Tuple[jax.Array, Metrics, Any]:
         tokens = batch["tokens"]
-        out, new_ms, aux = _apply_model(
+        out, new_ms, aux, extra = _apply_model(
             model, params, model_state, tokens, rng, train
         )
         targets = tokens[:, 1:]
@@ -104,13 +112,13 @@ class CausalLMTask:
             )
             loss = per_tok.mean() + aux
             accuracy = 100.0 * jnp.mean(argmax == targets)
-            return loss, {"loss": loss, "accuracy": accuracy}, new_ms
+            return loss, {"loss": loss, "accuracy": accuracy, **extra}, new_ms
         logits = out[:, :-1]
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), targets
         ).mean() + aux
         accuracy = 100.0 * jnp.mean(jnp.argmax(logits, axis=-1) == targets)
-        return loss, {"loss": loss, "accuracy": accuracy}, new_ms
+        return loss, {"loss": loss, "accuracy": accuracy, **extra}, new_ms
 
 
 class MLMTask:
@@ -166,7 +174,7 @@ class MLMTask:
             jnp.asarray(self.mask_token_id, tokens.dtype),
             jnp.where(selected & (kind >= 0.9), random_tokens, tokens),
         )
-        out, new_ms, aux = _apply_model(
+        out, new_ms, aux, extra = _apply_model(
             model, params, model_state, masked_inputs, rng_drop, train
         )
         denom = jnp.maximum(selected.sum(), 1)
@@ -182,11 +190,11 @@ class MLMTask:
             loss = jnp.where(selected, per_tok, 0.0).sum() / denom + aux
             correct = jnp.where(selected, argmax == tokens, False)
             accuracy = 100.0 * correct.sum() / denom
-            return loss, {"loss": loss, "accuracy": accuracy}, new_ms
+            return loss, {"loss": loss, "accuracy": accuracy, **extra}, new_ms
         per_tok = optax.softmax_cross_entropy_with_integer_labels(
             out.astype(jnp.float32), tokens
         )
         loss = jnp.where(selected, per_tok, 0.0).sum() / denom + aux
         correct = jnp.where(selected, jnp.argmax(out, axis=-1) == tokens, False)
         accuracy = 100.0 * correct.sum() / denom
-        return loss, {"loss": loss, "accuracy": accuracy}, new_ms
+        return loss, {"loss": loss, "accuracy": accuracy, **extra}, new_ms

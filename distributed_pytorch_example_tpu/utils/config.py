@@ -62,7 +62,7 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     parser.add_argument("--mesh-expert", type=int, default=1)
     parser.add_argument("--mesh-pipe", type=int, default=1,
                         help=">1: GPipe pipeline stages over the 'pipe' mesh "
-                        "axis (gpt2; layers split across stages)")
+                        "axis (gpt2, llama; layers split across stages)")
     parser.add_argument("--pipe-microbatches", type=int, default=0,
                         help="microbatches per pipelined step (0 = auto; "
                         "must divide batch and be a multiple of --mesh-pipe)")

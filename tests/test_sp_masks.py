@@ -122,7 +122,7 @@ def test_bert_pad_token_trains_under_sp_mesh(devices, sp_mode):
     kwargs = dict(
         vocab_size=vocab, max_len=seq, model_dim=32, num_layers=2,
         num_heads=4, mlp_dim=64, dtype=jnp.float32, use_flash=False,
-        pad_token_id=0,
+        pad_token_id=0, logits_mode="hidden",  # fused CE: train.py default
     )
     rng = np.random.default_rng(0)
     tokens_np = rng.integers(1, vocab, (8, seq)).astype(np.int32)

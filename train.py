@@ -226,9 +226,9 @@ def main():
                      "data parallelism with nothing sharded on the expert "
                      "axis; set --moe-experts too")
     if args.mesh_pipe not in (0, 1):
-        if not args.model.startswith("gpt"):
-            parser.error(f"--mesh-pipe is only supported for gpt2 models, "
-                         f"not {args.model!r}")
+        if not args.model.startswith(("gpt", "llama")):
+            parser.error(f"--mesh-pipe is only supported for gpt2 and llama "
+                         f"models, not {args.model!r}")
         overrides["pipe_axis"] = "pipe"
         overrides["pipe_microbatches"] = args.pipe_microbatches
     model = dpx.models.get_model(args.model, **overrides)
