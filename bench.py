@@ -82,7 +82,7 @@ def run_model(name: str, args) -> dict:
     import distributed_pytorch_example_tpu as dpx
 
     lm = name.startswith(("gpt", "bert"))
-    batch_per_chip = args.batch_per_chip or (8 if lm else 128)
+    batch_per_chip = args.batch_per_chip or (16 if lm else 128)
     if name == "resnet18":
         image_size, num_classes = 32, 10  # BASELINE config 1: CIFAR-10
         batch_per_chip = args.batch_per_chip or 256
@@ -210,9 +210,12 @@ def main():
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--seq-len", type=int, default=1024)
     parser.add_argument("--batch-per-chip", type=int, default=None,
-                        help="default: 128 (vision), 256 (resnet18), 8 (LM)")
-    parser.add_argument("--warmup", type=int, default=5)
-    parser.add_argument("--steps", type=int, default=20)
+                        help="default: 128 (vision), 256 (resnet18), 16 (LM)")
+    parser.add_argument("--warmup", type=int, default=8,
+                        help="untimed steady-state steps before timing")
+    parser.add_argument("--steps", type=int, default=40,
+                        help="timed steps; short windows under-measure by "
+                        "several MFU points over the tunneled device link")
     parser.add_argument("--remat", action="store_true",
                         help="rematerialized transformer blocks (LM models)")
     parser.add_argument("--flash", default="auto",

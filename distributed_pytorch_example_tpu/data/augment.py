@@ -18,7 +18,10 @@ Recipes:
   horizontal flip (the CIFAR-10 standard; disable flip for datasets where
   mirroring changes the label, e.g. digits);
 - :func:`random_resized_crop_flip` — area/aspect-jittered crop resized to
-  a target size + flip (the ImageNet standard; bilinear via scipy.ndimage).
+  a target size + flip (the ImageNet standard; bilinear via vectorized
+  NumPy gathers — ``scipy.ndimage.zoom``'s generic spline machinery
+  measured ~10-20 ms/image, capping the 224px pipeline near 60 samples/s
+  against a >2,400 samples/s chip).
 """
 
 from __future__ import annotations
@@ -27,15 +30,60 @@ from typing import Callable, Dict
 
 import numpy as np
 
+from distributed_pytorch_example_tpu.runtime.logging import get_logger
+
+# transforms may optionally accept an ``rng`` kwarg (thread-safe parallel
+# augmentation — see AugmentedDataset.workers)
 BatchTransform = Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]
 
 
 class AugmentedDataset:
-    """Wrap any map-style dataset with a train-time batch transform."""
+    """Wrap any map-style dataset with a train-time batch transform.
 
-    def __init__(self, dataset, transform: BatchTransform):
+    ``workers > 1`` splits each batch across a thread pool and transforms
+    the sub-batches concurrently — the analogue of the reference's
+    ``DataLoader(num_workers=2)`` (reference train.py:112): NumPy's big
+    gather/blend loops release the GIL, so per-image augmentation (the
+    224px random-resized-crop) scales across cores instead of capping the
+    pipeline at one core's throughput.
+
+    Determinism under threading: the batch is split on a FIXED 32-row
+    chunk grid (independent of the worker count), and each chunk gets its
+    OWN Generator seeded from (seed, call counter, chunk index) — so
+    results depend on neither thread scheduling nor how many workers/CPUs
+    the machine has. Transforms accept an optional ``rng``.
+    """
+
+    CHUNK = 32  # fixed randomness grid; workers only change parallelism
+
+    def __init__(
+        self, dataset, transform: BatchTransform, workers: int = 1,
+        seed: int = 0,
+    ):
+        import inspect
+
         self.dataset = dataset
         self.transform = transform
+        self.workers = max(1, int(workers))
+        self.seed = seed
+        self._calls = 0
+        self._pool = None
+        # parallel sub-batches need per-call generators; a transform
+        # without an ``rng`` kwarg (arbitrary user callable — this class
+        # wraps ANY transform) cannot take one, so it runs single-threaded
+        # rather than crashing or racing a shared generator
+        try:
+            params = inspect.signature(transform).parameters
+            self._takes_rng = "rng" in params
+        except (TypeError, ValueError):
+            self._takes_rng = False
+        if self.workers > 1 and not self._takes_rng:
+            get_logger(__name__).warning(
+                "AugmentedDataset: transform %r has no rng kwarg; running "
+                "single-threaded (workers=%d ignored)",
+                getattr(transform, "__name__", transform), self.workers,
+            )
+            self.workers = 1
 
     def __len__(self) -> int:
         return len(self.dataset)
@@ -47,7 +95,47 @@ class AugmentedDataset:
     def get_batch(self, indices: np.ndarray) -> Dict[str, np.ndarray]:
         from distributed_pytorch_example_tpu.data.loader import _get_batch
 
-        return self.transform(_get_batch(self.dataset, indices))
+        batch = _get_batch(self.dataset, indices)
+        n = len(indices)
+        if not self._takes_rng:
+            return self.transform(batch)
+        # rng-capable transform: ALWAYS run on the fixed chunk grid with
+        # (seed, call, chunk) generators, so the augmentation stream is
+        # identical for every worker count (1..N) and every machine
+        call = self._calls
+        self._calls += 1
+        bounds = list(range(0, n, self.CHUNK)) + [n]
+        subs = [
+            {k: v[lo:hi] for k, v in batch.items()}
+            for lo, hi in zip(bounds, bounds[1:])
+            if hi > lo
+        ]
+        rngs = [
+            np.random.default_rng((self.seed, call, j))
+            for j in range(len(subs))
+        ]
+        if self.workers == 1 or len(subs) == 1:
+            parts = [
+                self.transform(s, rng=r) for s, r in zip(subs, rngs)
+            ]
+        else:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.workers, thread_name_prefix="augment"
+                )
+            parts = list(
+                self._pool.map(
+                    lambda sr: self.transform(sr[0], rng=sr[1]),
+                    zip(subs, rngs),
+                )
+            )
+        if len(parts) == 1:
+            return parts[0]
+        return {
+            k: np.concatenate([p[k] for p in parts]) for k in parts[0]
+        }
 
     def __getattr__(self, name):  # num_classes etc. pass through
         return getattr(self.dataset, name)
@@ -58,25 +146,68 @@ def pad_crop_flip(
 ) -> BatchTransform:
     """CIFAR-standard augmentation: zero-pad ``pad``, random-crop back,
     mirror horizontally with p=0.5."""
-    rng = np.random.default_rng(seed)
+    import threading
 
-    def transform(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    shared_rng = np.random.default_rng(seed)
+    rng_lock = threading.Lock()  # Generator is not thread-safe; with no
+    # per-call rng the cheap draws serialize while the pixel work
+    # parallelizes (AugmentedDataset workers)
+
+    def transform(
+        batch: Dict[str, np.ndarray], rng: np.random.Generator = None
+    ) -> Dict[str, np.ndarray]:
         x = batch["x"]
         b, h, w, _ = x.shape
         padded = np.pad(
             x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant"
         )
-        offs = rng.integers(0, 2 * pad + 1, (b, 2))
+        if rng is None:
+            with rng_lock:
+                offs = shared_rng.integers(0, 2 * pad + 1, (b, 2))
+                mirror_draw = shared_rng.random(b)
+        else:
+            offs = rng.integers(0, 2 * pad + 1, (b, 2))
+            mirror_draw = rng.random(b)
         out = np.empty_like(x)
         for i in range(b):
             oy, ox = offs[i]
             out[i] = padded[i, oy : oy + h, ox : ox + w]
         if flip:
-            mirrored = rng.random(b) < 0.5
+            mirrored = mirror_draw < 0.5
             out[mirrored] = out[mirrored, :, ::-1]
         return {**batch, "x": out}
 
     return transform
+
+
+def _bilinear_resize(crop: np.ndarray, size: int) -> np.ndarray:
+    """(H, W, C) -> (size, size, C) bilinear, pixel-center aligned.
+
+    Sample positions follow ``ndimage.zoom(..., order=1, grid_mode=True,
+    mode='nearest')`` semantics: output center i maps to input
+    (i + 0.5) * in/out - 0.5, edges clamped. Pure-NumPy gathers + blends:
+    ~two orders of magnitude faster than the generic spline path.
+    """
+    ch, cw, _ = crop.shape
+    dtype = crop.dtype
+    ys = (np.arange(size) + 0.5) * (ch / size) - 0.5
+    xs = (np.arange(size) + 0.5) * (cw / size) - 0.5
+    y0 = np.floor(ys).astype(np.intp)
+    x0 = np.floor(xs).astype(np.intp)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    y0c = np.clip(y0, 0, ch - 1)
+    y1c = np.clip(y0 + 1, 0, ch - 1)
+    x0c = np.clip(x0, 0, cw - 1)
+    x1c = np.clip(x0 + 1, 0, cw - 1)
+    c = crop.astype(np.float32)
+    # separable: blend rows first (size, W, C), then columns (size, size, C)
+    rows = c[y0c] * (1.0 - wy) + c[y1c] * wy
+    out = rows[:, x0c] * (1.0 - wx) + rows[:, x1c] * wx
+    if np.issubdtype(dtype, np.integer):
+        info = np.iinfo(dtype)
+        return np.clip(np.rint(out), info.min, info.max).astype(dtype)
+    return out.astype(dtype)
 
 
 def random_resized_crop_flip(
@@ -88,33 +219,45 @@ def random_resized_crop_flip(
 ) -> BatchTransform:
     """ImageNet-standard augmentation: crop a random area/aspect region,
     resize (bilinear) to ``size`` x ``size``, mirror with p=0.5."""
-    from scipy import ndimage
+    import threading
 
-    rng = np.random.default_rng(seed)
+    shared_rng = np.random.default_rng(seed)
+    rng_lock = threading.Lock()  # Generator is not thread-safe; with no
+    # per-call rng the cheap draws serialize while the pixel work
+    # parallelizes (AugmentedDataset workers)
 
-    def transform(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        x = batch["x"]
-        b, h, w, c = x.shape
-        out = np.empty((b, size, size, c), x.dtype)
-        for i in range(b):
+    def draw_params(r, b, h, w):
+        crops = []
+        for _ in range(b):
             for _ in range(10):  # torchvision's rejection-sample loop
-                area = h * w * rng.uniform(*scale)
-                aspect = np.exp(rng.uniform(np.log(ratio[0]), np.log(ratio[1])))
+                area = h * w * r.uniform(*scale)
+                aspect = np.exp(r.uniform(np.log(ratio[0]), np.log(ratio[1])))
                 ch = int(round(np.sqrt(area / aspect)))
                 cw = int(round(np.sqrt(area * aspect)))
                 if 0 < ch <= h and 0 < cw <= w:
                     break
             else:  # fallback: center crop of the short side
                 ch = cw = min(h, w)
-            oy = rng.integers(0, h - ch + 1)
-            ox = rng.integers(0, w - cw + 1)
-            crop = x[i, oy : oy + ch, ox : ox + cw]
-            out[i] = ndimage.zoom(
-                crop, (size / ch, size / cw, 1), order=1, mode="nearest",
-                grid_mode=True,
-            )
+            oy = int(r.integers(0, h - ch + 1))
+            ox = int(r.integers(0, w - cw + 1))
+            crops.append((oy, ox, ch, cw))
+        return crops, r.random(b)
+
+    def transform(
+        batch: Dict[str, np.ndarray], rng: np.random.Generator = None
+    ) -> Dict[str, np.ndarray]:
+        x = batch["x"]
+        b, h, w, c = x.shape
+        if rng is None:
+            with rng_lock:
+                crops, mirror_draw = draw_params(shared_rng, b, h, w)
+        else:
+            crops, mirror_draw = draw_params(rng, b, h, w)
+        out = np.empty((b, size, size, c), x.dtype)
+        for i, (oy, ox, ch, cw) in enumerate(crops):
+            out[i] = _bilinear_resize(x[i, oy : oy + ch, ox : ox + cw], size)
         if flip:
-            mirrored = rng.random(b) < 0.5
+            mirrored = mirror_draw < 0.5
             out[mirrored] = out[mirrored, :, ::-1]
         return {**batch, "x": out}
 

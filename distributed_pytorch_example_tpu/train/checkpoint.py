@@ -132,6 +132,14 @@ def _write_payload(path: str, host_state, epoch: int, loss: float, extra) -> Non
         "extra": extra or {},
     }
     _atomic_write(path, serialization.msgpack_serialize(payload))
+    # a job that switched from --checkpoint-format sharded to gathered
+    # mid-life would otherwise strand {path}.shards forever: once the
+    # gathered file is committed at `path`, the old shard root is
+    # unreferenced (the pointer it served was just overwritten)
+    stale = f"{path}.shards"
+    if os.path.isdir(stale):
+        shutil.rmtree(stale, ignore_errors=True)
+        logger.info("Removed stale shard root %s (format switch)", stale)
     logger.info("Checkpoint saved to %s", path)
 
 

@@ -1,4 +1,4 @@
-"""Autoregressive text generation with KV caching.
+"""Autoregressive text generation with KV caching — single- or multi-chip.
 
 Beyond-reference capability (the reference trains only); the inference
 side every LM user expects. TPU-first shape: the whole decode loop is ONE
@@ -15,15 +15,26 @@ Usage::
 The decode-mode model adds only a ``cache`` collection; its ``params``
 tree is identical to the training model's, so trained checkpoints load
 unchanged.
+
+**Sharded decode**: pass ``partitioner=`` (the same Partitioner that
+trained the model) and the decode runs under its mesh — the prompt/output
+batch sharded over the data axes, decode weights under the training
+partition rules (Megatron TP stays TP at decode), and the KV caches
+sharded to match: batch over data axes, the kv-heads dim over ``tensor``
+(the cache follows the same head partitioning as the k/v projections that
+fill it). A model trained at ``tensor=8`` samples without ever gathering
+its weights or caches onto one device.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 def _sample(logits, rng, temperature: float, top_k: Optional[int],
@@ -47,13 +58,34 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int],
     return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 
+def _constrain_cache(cache, mesh, batch_axes: Tuple):
+    """Pin decode-cache shardings: batch over the data axes, kv heads over
+    'tensor' when they divide (matching the k/v projection partitioning
+    that writes them); cursors replicated."""
+
+    def spec_for(path, leaf):
+        name = getattr(path[-1], "key", "")
+        if name in ("cached_key", "cached_value") and leaf.ndim == 4:
+            tp = mesh.shape.get("tensor", 1)
+            heads = "tensor" if tp > 1 and leaf.shape[2] % tp == 0 else None
+            return lax.with_sharding_constraint(
+                leaf,
+                NamedSharding(mesh, P(batch_axes or None, None, heads, None)),
+            )
+        return lax.with_sharding_constraint(leaf, NamedSharding(mesh, P()))
+
+    return jax.tree_util.tree_map_with_path(spec_for, cache)
+
+
 @partial(
     jax.jit,
     static_argnums=(0, 3),
-    static_argnames=("temperature", "top_k", "top_p", "eos_id"),
+    static_argnames=("temperature", "top_k", "top_p", "eos_id", "mesh",
+                     "batch_axes"),
 )
 def _generate_jit(model, params, prompt, max_new_tokens, rng, *,
-                  temperature, top_k, top_p, eos_id):
+                  temperature, top_k, top_p, eos_id, mesh=None,
+                  batch_axes=()):
     batch, prompt_len = prompt.shape
     cache_len = prompt_len + max_new_tokens
     # size the caches on a full-length dummy (params from init are unused)
@@ -61,6 +93,8 @@ def _generate_jit(model, params, prompt, max_new_tokens, rng, *,
         jax.random.key(0), jnp.zeros((batch, cache_len), jnp.int32),
         train=False,
     )["cache"]
+    if mesh is not None:
+        cache = _constrain_cache(cache, mesh, tuple(batch_axes))
 
     # prefill: run the whole prompt through in one call
     logits, vars_ = model.apply(
@@ -107,6 +141,7 @@ def generate(
     top_p: Optional[float] = None,
     eos_id: Optional[int] = None,
     rng: Optional[jax.Array] = None,
+    partitioner=None,
 ) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` (B, P) int32.
 
@@ -115,6 +150,12 @@ def generate(
     (nucleus) truncate the sampling distribution; with ``eos_id``, sequences keep emitting EOS
     after their first one (shapes stay static — trim on host). Returns
     (B, P + max_new_tokens) token ids.
+
+    ``partitioner``: a ``parallel.Partitioner`` (typically the one that
+    trained the model) for sharded decode — params follow its rules
+    (TP-sharded weights stay sharded), the prompt batch shards over the
+    data axes, and the KV caches shard to match. Without it the decode is
+    single-logical-device (params as given).
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
@@ -137,7 +178,29 @@ def generate(
         )
     if rng is None:
         rng = jax.random.key(0)
-    return _generate_jit(
-        model, params, prompt, max_new_tokens, rng,
-        temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
-    )
+    if partitioner is None:
+        return _generate_jit(
+            model, params, prompt, max_new_tokens, rng,
+            temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
+        )
+    mesh = partitioner.mesh
+    batch_axes = partitioner.batch_spec()[0]
+    if isinstance(batch_axes, str):
+        batch_axes = (batch_axes,)
+    batch_axes = tuple(batch_axes or ())
+    dp = 1
+    for a in batch_axes:
+        dp *= mesh.shape.get(a, 1)
+    if dp > 1 and prompt.shape[0] % dp:
+        raise ValueError(
+            f"prompt batch {prompt.shape[0]} not divisible by the data-axis "
+            f"span {dp} of mesh {dict(mesh.shape)}"
+        )
+    params = partitioner.shard_tree(params)
+    prompt = jax.device_put(prompt, partitioner.batch_sharding())
+    with mesh:
+        return _generate_jit(
+            model, params, prompt, max_new_tokens, rng,
+            temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
+            mesh=mesh, batch_axes=batch_axes,
+        )

@@ -38,6 +38,11 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         help="train-time augmentation: cifar = pad-crop + "
                         "flip, crop = pad-crop only (label-asymmetric data "
                         "like digits), imagenet = random-resized-crop + flip")
+    parser.add_argument("--augment-workers", type=int, default=0,
+                        help="threads transforming each batch's augmentation "
+                        "in parallel (reference DataLoader num_workers "
+                        "analogue, train.py:112); 0 = one per 32 images, "
+                        "capped at cpu count")
     parser.add_argument("--seq-len", type=int, default=512)
     parser.add_argument("--token-dtype", type=str, default="uint16",
                         choices=("uint16", "uint32", "int32"),

@@ -210,3 +210,43 @@ def test_pointer_flips_only_after_manifest_commit(tmp_path, devices, monkeypatch
     assert order.index("shard_00000.msgpack") < order.index(
         "manifest.msgpack"
     ), order
+
+
+def test_format_switch_gcs_stale_shard_root(tmp_path, devices):
+    """Switching --checkpoint-format sharded -> gathered mid-life must not
+    strand {path}.shards forever (VERDICT r2 weak #6): committing the
+    gathered file removes the now-unreferenced shard root, and the
+    checkpoint keeps loading (as gathered)."""
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    state, shardings = _fsdp_state(mesh)
+    path = str(tmp_path / "latest_model.ckpt")
+    ckpt_lib.save_checkpoint(path, state, 1, 0.9, sharded=True)
+    assert os.path.isdir(path + ".shards")
+
+    ckpt_lib.save_checkpoint(path, state, 2, 0.8, sharded=False)
+    assert not os.path.exists(path + ".shards")  # stale root GC'd
+    restored, epoch, _ = ckpt_lib.load_checkpoint(path, state, shardings)
+    assert epoch == 2
+    _tree_equal(restored, state)
+
+
+def test_best_and_latest_shard_roots_are_independent(tmp_path, devices):
+    """best/latest each own their shard root ({path}.shards); saving one at
+    a newer version must not GC or corrupt the other's, and each pointer
+    restores its own epoch."""
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    state, shardings = _fsdp_state(mesh)
+    best = str(tmp_path / "best_model.ckpt")
+    latest = str(tmp_path / "latest_model.ckpt")
+
+    ckpt_lib.save_checkpoint(best, state, 3, 0.5, sharded=True)
+    # latest advances several epochs past best
+    for epoch in (3, 4, 5):
+        ckpt_lib.save_checkpoint(latest, state, epoch, 0.4, sharded=True)
+
+    _, best_epoch, _ = ckpt_lib.load_checkpoint(best, state, shardings)
+    _, latest_epoch, _ = ckpt_lib.load_checkpoint(latest, state, shardings)
+    assert (best_epoch, latest_epoch) == (3, 5)
+    # latest's GC kept only its newest version; best's root is untouched
+    assert len(os.listdir(latest + ".shards")) == 1
+    assert len(os.listdir(best + ".shards")) == 1

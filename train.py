@@ -163,7 +163,12 @@ def main():
             transform = pad_crop_flip(
                 flip=args.augment == "cifar", seed=args.seed
             )
-        train_ds = AugmentedDataset(train_ds, transform)  # train only
+        workers = args.augment_workers or min(
+            max(1, global_batch // 32), os.cpu_count() or 1
+        )
+        train_ds = AugmentedDataset(
+            train_ds, transform, workers=workers, seed=args.seed
+        )
     # real datasets know their label space; the flag default (10) must not
     # silently size a too-small classifier head for e.g. ImageNet shards
     ds_classes = getattr(train_ds, "num_classes", 0)
