@@ -11,7 +11,11 @@ matching BASELINE.json) with every other model's numbers embedded under
 MFU (model FLOPs utilization) comes from XLA's own cost analysis of the
 compiled train step (forward + backward + optimizer), divided by measured
 step rate x the chip's peak bf16 FLOP/s — so "fast" is judged against the
-hardware ceiling, not just a baseline anchor.
+hardware ceiling, not just a baseline anchor. NB: XLA counts Pallas
+custom calls (the flash-attention kernels) as ZERO FLOPs, so LM MFU here
+is CONSERVATIVE — at seq 1024 the uncounted attention FLOPs are ~8% of
+the GPT-2 step (scripts/bench_longctx.py reports the analytic accounting
+where the attention share grows large).
 
 Anchors in ``BASELINES``: 60% of published torch-xla-order rates (the
 BASELINE.json north star); order-of-magnitude GUESSES, not measurements —

@@ -13,7 +13,11 @@ uses, demonstrating the framework generalizes past the GPT-2/BERT classics:
   q-head blocks to their kv head via the BlockSpec index map;
 - **SwiGLU** MLP (silu(gate) * up -> down), param paths ``mlp/gate|up|down``
   matching the Megatron column/row partition rules;
-- untied LM head.
+- untied LM head;
+- optional **Mixtral-style MoE** (``moe_experts > 0``): every
+  ``moe_every``-th block swaps its dense MLP for top-2-routed SwiGLU
+  experts (models/moe.py with ``swiglu=True``), expert weights sharded on
+  the ``expert`` mesh axis.
 
 The default config is a ~110M toy ("llama-tiny") so the zoo entry trains
 on one chip; override fields for real sizes.
@@ -51,7 +55,7 @@ class SwiGluMlp(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, train: bool = False):
         gate = nn.Dense(
             self.mlp_dim, use_bias=False, dtype=self.dtype, name="gate"
         )(x)
@@ -77,6 +81,9 @@ class LlamaBlock(nn.Module):
     seq_axis: Optional[str] = None
     sp_mode: str = "ulysses"  # GQA needs the all-to-all SP path
     decode: bool = False
+    moe_experts: int = 0  # >0: Mixtral-style SwiGLU-expert MoE MLP
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -95,14 +102,28 @@ class LlamaBlock(nn.Module):
             decode=self.decode,
             name="attn",
         )
-        mlp = SwiGluMlp(
-            mlp_dim=self.mlp_dim, model_dim=self.model_dim, dtype=self.dtype,
-            name="mlp",
-        )
+        if self.moe_experts:
+            from distributed_pytorch_example_tpu.models.moe import MoEMlpBlock
+
+            mlp = MoEMlpBlock(
+                num_experts=self.moe_experts,
+                mlp_dim=self.mlp_dim,
+                model_dim=self.model_dim,
+                top_k=self.moe_top_k,
+                capacity_factor=self.moe_capacity_factor,
+                dtype=self.dtype,
+                swiglu=True,  # Mixtral: experts are SwiGLU like the dense MLP
+                name="moe",
+            )
+        else:
+            mlp = SwiGluMlp(
+                mlp_dim=self.mlp_dim, model_dim=self.model_dim,
+                dtype=self.dtype, name="mlp",
+            )
         ln1 = RMSNorm(self.layer_norm_epsilon, self.dtype, name="ln1")
         ln2 = RMSNorm(self.layer_norm_epsilon, self.dtype, name="ln2")
         x = x + attn(ln1(x), train=train)
-        return x + mlp(ln2(x))
+        return x + mlp(ln2(x), train=train)
 
 
 class Llama(nn.Module):
@@ -124,6 +145,10 @@ class Llama(nn.Module):
     remat: bool = False
     pipe_axis: Optional[str] = None  # mesh axis for pipeline stages (PP)
     pipe_microbatches: int = 0  # 0 = auto
+    moe_experts: int = 0  # >0: Mixtral-style MoE on every moe_every-th block
+    moe_every: int = 2
+    moe_top_k: int = 2  # Mixtral default: 2 experts per token
+    moe_capacity_factor: float = 1.25
     # "full": (B, S, V) logits. "hidden": final hidden states for the fused
     # chunked-CE loss (train/tasks.py + ``head_params``).
     logits_mode: str = "full"
@@ -144,10 +169,15 @@ class Llama(nn.Module):
             )
         if self.decode and self.logits_mode != "full":
             raise ValueError("decode mode requires logits_mode='full'")
-        if self.pipe_axis is not None and self.seq_axis:
+        if self.pipe_axis is not None and (self.seq_axis or self.moe_experts):
             raise ValueError(
-                "pipe_axis cannot combine with seq_axis yet (the pipeline "
-                "stages are whole-sequence dense blocks)"
+                "pipe_axis cannot combine with seq_axis or moe_experts yet "
+                "(the pipeline stages are homogeneous dense blocks)"
+            )
+        if self.moe_experts > 0 and self.moe_every < 1:
+            raise ValueError(
+                f"moe_every must be >= 1 when moe_experts > 0, got "
+                f"{self.moe_every}"
             )
         if self.pipe_axis is not None and self.decode:
             raise ValueError(
@@ -189,6 +219,10 @@ class Llama(nn.Module):
             return self._head(x)
 
         for i in range(self.num_layers):
+            is_moe = (
+                self.moe_experts > 0
+                and i % self.moe_every == self.moe_every - 1
+            )
             block = LlamaBlock(
                 num_heads=self.num_heads,
                 num_kv_heads=self.num_kv_heads,
@@ -201,6 +235,9 @@ class Llama(nn.Module):
                 seq_axis=self.seq_axis,
                 sp_mode=self.sp_mode,
                 decode=self.decode,
+                moe_experts=self.moe_experts if is_moe else 0,
+                moe_top_k=self.moe_top_k,
+                moe_capacity_factor=self.moe_capacity_factor,
                 name=f"layer_{i}",
             )
             if self.remat:

@@ -45,6 +45,9 @@ class MoEMlpBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     aux_loss_weight: float = 0.01
     z_loss_weight: float = 1e-3
+    # SwiGLU experts (Mixtral-style, for the LLaMA family): each expert is
+    # silu(x @ gate) * (x @ up) -> down instead of gelu(x @ up) -> down
+    swiglu: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -121,36 +124,49 @@ class MoEMlpBlock(nn.Module):
                 1.0 - kept / (batch * seq * k),
             )
 
-        # expert weights: leading expert dim is the EP sharding target
+        # expert weights: leading expert dim is the EP sharding target.
+        # Bias convention mirrors the dense MLP each expert replaces:
+        # gelu experts (transformer MlpBlock) carry biases, SwiGLU experts
+        # (llama SwiGluMlp, Mixtral) are bias-free throughout.
         w_up = self.param(
             "up_kernel",
             nn.initializers.lecun_normal(batch_axis=(0,)),
             (n_exp, dim, self.mlp_dim),
-        ).astype(self.dtype)
-        b_up = self.param(
-            "up_bias", nn.initializers.zeros_init(), (n_exp, self.mlp_dim)
         ).astype(self.dtype)
         w_down = self.param(
             "down_kernel",
             nn.initializers.lecun_normal(batch_axis=(0,)),
             (n_exp, self.mlp_dim, dim),
         ).astype(self.dtype)
-        b_down = self.param(
-            "down_bias", nn.initializers.zeros_init(), (n_exp, dim)
-        ).astype(self.dtype)
+        b_up = b_down = None
+        if not self.swiglu:
+            b_up = self.param(
+                "up_bias", nn.initializers.zeros_init(), (n_exp, self.mlp_dim)
+            ).astype(self.dtype)
+            b_down = self.param(
+                "down_bias", nn.initializers.zeros_init(), (n_exp, dim)
+            ).astype(self.dtype)
 
         # dispatch → expert MLP → combine: all einsums, XLA inserts the
         # all-to-alls when 'expert' spans devices
         expert_in = jnp.einsum(
             "bsec,bsd->ebcd", dispatch.astype(self.dtype), x
         )  # (E, B, C, D)
-        h = nn.gelu(
-            jnp.einsum("ebcd,edf->ebcf", expert_in, w_up)
-            + b_up[:, None, None, :]
-        )
-        expert_out = (
-            jnp.einsum("ebcf,efd->ebcd", h, w_down) + b_down[:, None, None, :]
-        )
+        up = jnp.einsum("ebcd,edf->ebcf", expert_in, w_up)
+        if self.swiglu:
+            w_gate = self.param(
+                "gate_kernel",
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                (n_exp, dim, self.mlp_dim),
+            ).astype(self.dtype)
+            h = nn.silu(
+                jnp.einsum("ebcd,edf->ebcf", expert_in, w_gate)
+            ) * up
+        else:
+            h = nn.gelu(up + b_up[:, None, None, :])
+        expert_out = jnp.einsum("ebcf,efd->ebcd", h, w_down)
+        if not self.swiglu:
+            expert_out = expert_out + b_down[:, None, None, :]
         out = jnp.einsum(
             "bsec,ebcd->bsd", combine.astype(self.dtype), expert_out
         )

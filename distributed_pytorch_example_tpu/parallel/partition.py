@@ -42,7 +42,7 @@ TRANSFORMER_TP_RULES: tuple = (
     (r"mlp/down/kernel$", P("tensor", None)),
     # expert parallelism: MoE expert dim sharded on 'expert'; the router
     # stays replicated (tiny, and every token needs it)
-    (r"moe/(up|down)_kernel$", P("expert", None, None)),
+    (r"moe/(up|down|gate)_kernel$", P("expert", None, None)),
     (r"moe/(up|down)_bias$", P("expert", None)),
     # layer-stacked decoder (models/stacked.py): leading num_layers dim on
     # 'pipe' (pipeline stages), features on 'tensor' per the same Megatron

@@ -76,9 +76,12 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         "attention (padding); default: no padding mask")
     parser.add_argument("--moe-experts", type=int, default=0,
                         help=">0: MoE MLP with this many experts on every "
-                        "other transformer block (gpt2)")
-    parser.add_argument("--moe-top-k", type=int, default=1,
-                        help="experts per token (1 = Switch, 2 = GShard)")
+                        "other transformer block (gpt2: gelu experts; "
+                        "llama: Mixtral-style SwiGLU experts)")
+    parser.add_argument("--moe-top-k", type=int, default=None,
+                        help="experts per token (1 = Switch, 2 = GShard/"
+                        "Mixtral); default: the model's own default "
+                        "(gpt2: 1, llama: 2)")
     parser.add_argument("--lm-loss", type=str, default="fused",
                         choices=("fused", "dense"),
                         help="LM-head loss path: fused = chunked vocab "

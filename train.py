@@ -217,13 +217,13 @@ def main():
         # all-gathers the mask after its head swap)
         overrides["pad_token_id"] = args.pad_token_id
     if args.moe_experts:
-        if not args.model.startswith("gpt"):
-            parser.error(f"--moe-experts is only supported for gpt2 models, "
-                         f"not {args.model!r}")
+        if not args.model.startswith(("gpt", "llama")):
+            parser.error(f"--moe-experts is only supported for gpt2 and "
+                         f"llama models, not {args.model!r}")
         overrides["moe_experts"] = args.moe_experts
-        if args.moe_top_k != 1:
+        if args.moe_top_k is not None:  # None: keep the model's default
             overrides["moe_top_k"] = args.moe_top_k
-    if args.moe_top_k != 1 and not args.moe_experts:
+    if args.moe_top_k is not None and not args.moe_experts:
         parser.error("--moe-top-k without --moe-experts has nothing to "
                      "route; set --moe-experts too")
     if args.mesh_expert not in (0, 1) and not args.moe_experts:
