@@ -253,12 +253,31 @@ def random_resized_crop_flip(
                 crops, mirror_draw = draw_params(shared_rng, b, h, w)
         else:
             crops, mirror_draw = draw_params(rng, b, h, w)
+        mirrored = (mirror_draw < 0.5) if flip else np.zeros(b, bool)
+        native = _native_crop()
+        if native is not None and x.dtype == np.uint8:
+            # C++ hot loop — bit-identical to the NumPy path below
+            # (pinned in tests/test_native.py), without its temporaries
+            return {**batch, "x": native(
+                x, np.asarray(crops, np.int64), mirrored, size
+            )}
         out = np.empty((b, size, size, c), x.dtype)
         for i, (oy, ox, ch, cw) in enumerate(crops):
             out[i] = _bilinear_resize(x[i, oy : oy + ch, ox : ox + cw], size)
-        if flip:
-            mirrored = mirror_draw < 0.5
-            out[mirrored] = out[mirrored, :, ::-1]
+        out[mirrored] = out[mirrored, :, ::-1]
         return {**batch, "x": out}
 
     return transform
+
+
+def _native_crop():
+    """The C++ resized-crop batch kernel, or None (NumPy fallback).
+
+    Dispatches through the shared native probe — a corrupt .so or a stale
+    build missing the symbol degrades to the NumPy path like every other
+    native call site, never crashes the first augmented batch.
+    """
+    from distributed_pytorch_example_tpu.native import get_binding
+
+    binding = get_binding()
+    return getattr(binding, "resized_crop_batch", None)

@@ -54,6 +54,7 @@ class StreamingImageShards:
         normalize: Optional[Tuple[np.ndarray, np.ndarray]] = None,
         transform: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]] = None,
         max_open_shards: int = 8,
+        raw_uint8: bool = False,
     ):
         if not os.path.isdir(root):
             raise FileNotFoundError(
@@ -104,6 +105,13 @@ class StreamingImageShards:
         self.labels = np.concatenate(labels)
         self._starts = np.concatenate([[0], np.cumsum(lengths)])
         self.num_classes = int(self.labels.max()) + 1 if len(self.labels) else 0
+        if raw_uint8 and normalize is not None:
+            raise ValueError(
+                "raw_uint8 ships unscaled uint8 rows (the [0,1] scaling "
+                "runs on device, train.tasks.dequantize_inputs); host-side "
+                "mean/std normalize cannot combine with it"
+            )
+        self.raw_uint8 = raw_uint8
         self.normalize = normalize
         self.transform = transform
         self.max_open_shards = max(1, max_open_shards)
@@ -134,7 +142,8 @@ class StreamingImageShards:
     def get_batch(self, indices: np.ndarray) -> Dict[str, np.ndarray]:
         indices = np.asarray(indices)
         shard_ids = np.searchsorted(self._starts, indices, side="right") - 1
-        x = np.empty((len(indices), *self.image_shape), np.float32)
+        dtype = np.uint8 if self.raw_uint8 else np.float32
+        x = np.empty((len(indices), *self.image_shape), dtype)
         # group rows by shard: one map touch per shard per batch, ascending
         # shard order keeps the LRU pool from thrashing
         for shard in np.unique(shard_ids):
@@ -143,10 +152,11 @@ class StreamingImageShards:
             # fancy indexing on a memmap copies the rows out — no views of
             # the map survive, so LRU-closing it later is safe
             x[sel] = self._map(int(shard))[local]
-        x /= 255.0
-        if self.normalize is not None:
-            mean, std = self.normalize
-            x = (x - mean) / std
+        if not self.raw_uint8:
+            x /= 255.0
+            if self.normalize is not None:
+                mean, std = self.normalize
+                x = (x - mean) / std
         batch = {"x": x, "y": self.labels[indices]}
         if self.transform is not None:
             batch = self.transform(batch)

@@ -59,6 +59,12 @@ def _load() -> ctypes.CDLL:
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
     ]
     lib.dpx_gather_rows.restype = None
+    lib.dpx_resized_crop_batch.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+    ]
+    lib.dpx_resized_crop_batch.restype = None
     return lib
 
 
@@ -72,6 +78,55 @@ def permutation(n: int, seed: int) -> np.ndarray:
         n,
         ctypes.c_uint64(seed & 0xFFFFFFFFFFFFFFFF),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out
+
+
+def resized_crop_batch(
+    images: np.ndarray,
+    crops: np.ndarray,
+    mirror: np.ndarray,
+    size: int,
+    n_threads: int = 1,
+) -> np.ndarray:
+    """Batched bilinear crop->resize(+mirror), uint8 NHWC.
+
+    Bit-identical to data/augment.py::_bilinear_resize followed by the
+    horizontal flip (pinned in tests/test_native.py): same pixel-center
+    sampling, double-precision blends, ties-to-even rounding.
+
+    Args:
+      images: (B, H, W, C) uint8.
+      crops: (B, 4) int64 rows (oy, ox, crop_h, crop_w); each crop must
+        lie inside the image and be at least 1x1.
+      mirror: (B,) bool/uint8 — flip the OUTPUT horizontally.
+      size: square output extent.
+    """
+    if images.dtype != np.uint8 or images.ndim != 4:
+        raise ValueError(f"images must be (B,H,W,C) uint8, got "
+                         f"{images.shape} {images.dtype}")
+    b, h, w, c = images.shape
+    cr = np.ascontiguousarray(crops, dtype=np.int64)
+    if cr.shape != (b, 4):
+        raise ValueError(f"crops must be ({b}, 4), got {cr.shape}")
+    oy, ox, ch, cw = cr[:, 0], cr[:, 1], cr[:, 2], cr[:, 3]
+    if (
+        (ch < 1).any() or (cw < 1).any() or (oy < 0).any() or (ox < 0).any()
+        or (oy + ch > h).any() or (ox + cw > w).any()
+    ):
+        raise ValueError("crop rectangles must lie inside the image")
+    if not images.flags.c_contiguous:
+        images = np.ascontiguousarray(images)
+    mir = np.ascontiguousarray(mirror, dtype=np.uint8)
+    out = np.empty((b, size, size, c), np.uint8)
+    _lib.dpx_resized_crop_batch(
+        images.ctypes.data_as(ctypes.c_char_p),
+        b, h, w, c,
+        cr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        mir.ctypes.data_as(ctypes.c_char_p),
+        out.ctypes.data_as(ctypes.c_char_p),
+        size,
+        n_threads,
     )
     return out
 

@@ -37,11 +37,15 @@ def init_state(
     """
 
     def init_fn(rng):
+        from distributed_pytorch_example_tpu.train.tasks import (
+            dequantize_inputs,
+        )
+
         rng_params, rng_dropout, rng_state = jax.random.split(rng, 3)
         variables = dict(
             model.init(
                 {"params": rng_params, "dropout": rng_dropout},
-                sample_inputs,
+                jax.tree_util.tree_map(dequantize_inputs, sample_inputs),
                 train=False,
             )
         )

@@ -112,3 +112,42 @@ def test_decode_rejects_hidden_mode():
             "gpt2", logits_mode="hidden", decode=True, **TINY
         )
         m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+
+
+def test_fused_loss_under_tensor_parallel_vocab_sharding(mesh_2x2x2):
+    """Fused chunked-CE under TP where the vocab-parallel rule shards the
+    tied embedding on 'tensor' (vocab 212 % 2 == 0): loss and grads must
+    match the same model on a replicated (DP) layout."""
+    import optax
+
+    from distributed_pytorch_example_tpu.parallel.partition import (
+        transformer_partitioner,
+    )
+
+    kwargs = dict(TINY)
+    kwargs["vocab_size"] = 212  # divisible by tensor=2: vocab-parallel path
+    model = dpx.models.get_model("gpt2", logits_mode="hidden", **kwargs)
+    task = CausalLMTask()
+    tokens = np.random.default_rng(0).integers(0, 212, (8, 16)).astype(np.int32)
+
+    losses = {}
+    for name, part in (
+        ("tp", transformer_partitioner(mesh_2x2x2)),
+        ("dp", dpx.parallel.data_parallel(mesh_2x2x2)),
+    ):
+        trainer = dpx.train.Trainer(
+            model, task, optax.adam(1e-3), partitioner=part
+        )
+        batch = {
+            "tokens": jax.make_array_from_process_local_data(
+                part.batch_sharding(), tokens
+            )
+        }
+        with mesh_2x2x2:
+            trainer.init(batch["tokens"])
+            if name == "tp":  # the embedding must actually be vocab-sharded
+                emb = trainer.state.params["wte"]["embedding"]
+                assert emb.sharding.spec[0] == "tensor"
+            _, metrics = trainer.train_step(trainer.state, batch)
+            losses[name] = float(metrics["loss"])
+    np.testing.assert_allclose(losses["tp"], losses["dp"], rtol=1e-4)

@@ -3,6 +3,8 @@
 import os
 import resource
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -159,3 +161,61 @@ def test_streaming_throughput_floor(tmp_path):
     dt = time.perf_counter() - t0
     mb = len(ds) * 32 * 32 * 3 / 1e6
     assert mb / dt > 50, f"streaming at {mb/dt:.1f} MB/s"
+
+
+def test_raw_uint8_matches_float_host_scaling(tmp_path, devices):
+    """raw_uint8 shards + on-device dequantize == the float32 host-/255
+    path: identical batches into the model, identical loss out of the
+    train step (the r3 uint8-to-device input contract)."""
+    import optax
+
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.runtime import MeshSpec, make_mesh
+    from distributed_pytorch_example_tpu.train.tasks import (
+        ClassificationTask,
+        dequantize_inputs,
+    )
+
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (32, 16, 16, 3)).astype(np.uint8)
+    labels = rng.integers(0, 5, 32).astype(np.int32)
+    root = str(tmp_path / "shards")
+    write_image_shards(root, [(imgs, labels)], shard_size=16)
+
+    ds_f32 = StreamingImageShards(root)
+    ds_u8 = StreamingImageShards(root, raw_uint8=True)
+    idx = np.arange(32)
+    bf, bu = ds_f32.get_batch(idx), ds_u8.get_batch(idx)
+    assert bu["x"].dtype == np.uint8
+    np.testing.assert_allclose(
+        np.asarray(dequantize_inputs(jnp.asarray(bu["x"]))), bf["x"],
+        rtol=1e-6,
+    )
+
+    with pytest.raises(ValueError, match="raw_uint8"):
+        StreamingImageShards(
+            root, raw_uint8=True,
+            normalize=(np.zeros(3, np.float32), np.ones(3, np.float32)),
+        )
+
+    # same loss through the jitted step either way (init incl.)
+    mesh = make_mesh(MeshSpec(data=8))
+    model = dpx.models.get_model("mlp")
+    losses = {}
+    for name, ds in (("u8", ds_u8), ("f32", ds_f32)):
+        b = ds.get_batch(idx)
+        b = {"x": b["x"].reshape(32, -1)[:, :784], "y": b["y"]}
+        trainer = dpx.train.Trainer(
+            model, ClassificationTask(), optax.adam(1e-3),
+            partitioner=dpx.parallel.data_parallel(mesh),
+        )
+        sharding = trainer.partitioner.batch_sharding()
+        batch = {
+            k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in b.items()
+        }
+        with mesh:
+            trainer.init(batch["x"])
+            _, metrics = trainer.train_step(trainer.state, batch)
+            losses[name] = float(metrics["loss"])
+    np.testing.assert_allclose(losses["u8"], losses["f32"], rtol=1e-5)
