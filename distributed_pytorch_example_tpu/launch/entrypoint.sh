@@ -15,6 +15,22 @@
 #   TRAINING_SCRIPT       script to run                      [default train.py]
 #   SCRIPT_ARGS           extra args forwarded to the script [default ""]
 #
+# Beyond-reference resilience (the reference's torchrun invocation is a
+# static rendezvous with NO restarts, reference entrypoint.sh:33-39 — a
+# crash kills the job and the only recovery is a manual relaunch with
+# --resume, reference train.py:256-257):
+#   MAX_RESTARTS    restarts after nonzero exits; each retry appends
+#                   `--resume <checkpoint dir>/latest_model.ckpt` so
+#                   training continues from the last epoch [default 0].
+#                   The checkpoint dir comes from --checkpoint-dir inside
+#                   SCRIPT_ARGS when present, else $CHECKPOINT_DIR.
+#                   Scope: per-host crash recovery — exits caused by
+#                   signals (rc > 128, e.g. pod teardown SIGTERM) are NOT
+#                   restarted, and a multi-host job only recovers if every
+#                   host exits (peers blocked in a collective must be
+#                   restarted by the orchestrator).
+#   CHECKPOINT_DIR  fallback checkpoint dir               [default ./checkpoints]
+#
 # Derived (reference entrypoint.sh:24-28 parity):
 #   PROCESS_ID          <- numeric suffix of $HOSTNAME   (NODE_RANK=${HOSTNAME##*-})
 #   COORDINATOR_ADDRESS <- ${BASE_NAME}-0.${NF_DISCOVERY_SERVICE}:${COORDINATOR_PORT}
@@ -53,5 +69,77 @@ fi
 
 export REPLICAS COORDINATOR_PORT
 
-# shellcheck disable=SC2086  # SCRIPT_ARGS is intentionally word-split
-exec python "${TRAINING_SCRIPT}" ${SCRIPT_ARGS}
+MAX_RESTARTS="${MAX_RESTARTS:-0}"
+CHECKPOINT_DIR="${CHECKPOINT_DIR:-./checkpoints}"
+
+if [ "${MAX_RESTARTS}" -le 0 ]; then
+  # shellcheck disable=SC2086  # SCRIPT_ARGS is intentionally word-split
+  exec python "${TRAINING_SCRIPT}" ${SCRIPT_ARGS}
+fi
+
+# supervised mode: retry crashed training with epoch-granularity resume.
+# The resume path must point where the trainer actually writes: prefer a
+# --checkpoint-dir inside SCRIPT_ARGS over the env fallback.
+ckpt_dir="${CHECKPOINT_DIR}"
+prev=""
+for arg in ${SCRIPT_ARGS}; do
+  if [ "${prev}" = "--checkpoint-dir" ]; then
+    ckpt_dir="${arg}"
+  fi
+  prev="${arg}"
+done
+resume_ckpt="${ckpt_dir}/latest_model.ckpt"
+
+# run python in the background so this (possibly PID-1) shell can forward
+# termination signals instead of absorbing them
+child=0
+forward() {
+  sig="$1"
+  if [ "${child}" -ne 0 ]; then
+    kill -s "${sig}" "${child}" 2>/dev/null || true
+  fi
+}
+trap 'forward TERM' TERM
+trap 'forward INT' INT
+
+# A later --resume wins in argparse, so appending ours overrides any
+# caller-provided one on retries.
+attempt=0
+resume_args=""
+while true; do
+  set +e
+  # shellcheck disable=SC2086
+  python "${TRAINING_SCRIPT}" ${SCRIPT_ARGS} ${resume_args} &
+  child=$!
+  wait "${child}"
+  rc=$?
+  # a second wait returns the real status if the first was interrupted by
+  # a trapped signal arriving in this shell
+  wait "${child}" 2>/dev/null
+  rc2=$?
+  [ "${rc2}" -ne 127 ] && rc="${rc2}"
+  child=0
+  set -e
+  if [ "${rc}" -eq 0 ]; then
+    exit 0
+  fi
+  if [ "${rc}" -gt 128 ]; then
+    # killed by a signal (orchestrator teardown): do not fight it
+    echo "INFO: training terminated by signal (rc=${rc}); not restarting" >&2
+    exit "${rc}"
+  fi
+  attempt=$((attempt + 1))
+  if [ "${attempt}" -gt "${MAX_RESTARTS}" ]; then
+    echo "ERROR: training failed (rc=${rc}) after ${MAX_RESTARTS} restarts; giving up" >&2
+    exit "${rc}"
+  fi
+  if [ -e "${resume_ckpt}" ]; then
+    echo "WARN: training exited rc=${rc}; restart ${attempt}/${MAX_RESTARTS}," \
+         "resuming from ${resume_ckpt}" >&2
+  else
+    echo "WARN: training exited rc=${rc}; restart ${attempt}/${MAX_RESTARTS};" \
+         "no checkpoint at ${resume_ckpt} yet — restarting from scratch" >&2
+  fi
+  resume_args="--resume ${resume_ckpt}"
+  sleep 2
+done

@@ -118,6 +118,8 @@ def resized_crop_batch(
     if not images.flags.c_contiguous:
         images = np.ascontiguousarray(images)
     mir = np.ascontiguousarray(mirror, dtype=np.uint8)
+    if mir.shape != (b,):
+        raise ValueError(f"mirror must be ({b},), got {mir.shape}")
     out = np.empty((b, size, size, c), np.uint8)
     _lib.dpx_resized_crop_batch(
         images.ctypes.data_as(ctypes.c_char_p),

@@ -106,3 +106,109 @@ def test_custom_port(tmp_path):
         tmp_path,
     )
     assert out["COORDINATOR_ADDRESS"] == "w-0.d:12345"
+
+
+def test_max_restarts_resumes_after_crash(tmp_path):
+    """MAX_RESTARTS: a crashing script is relaunched with --resume
+    <CHECKPOINT_DIR>/latest_model.ckpt appended; success stops the loop."""
+    stub = tmp_path / "stub.py"
+    marker = tmp_path / "attempts"
+    stub.write_text(
+        "import pathlib, sys\n"
+        f"m = pathlib.Path({str(marker)!r})\n"
+        "n = int(m.read_text()) if m.exists() else 0\n"
+        "m.write_text(str(n + 1))\n"
+        "print('ARGS:' + ' '.join(sys.argv[1:]))\n"
+        "sys.exit(1 if n < 2 else 0)\n"  # crash twice, then succeed
+    )
+    env = {
+        "PATH": os.environ["PATH"],
+        "TRAINING_SCRIPT": str(stub),
+        "SCRIPT_ARGS": "--epochs 5",
+        "MAX_RESTARTS": "3",
+        "CHECKPOINT_DIR": "/ck",
+    }
+    proc = subprocess.run(
+        ["bash", ENTRYPOINT], env=env, capture_output=True, text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    args_lines = [
+        l for l in proc.stdout.splitlines() if l.startswith("ARGS:")
+    ]
+    assert args_lines[0] == "ARGS:--epochs 5"  # first run: no resume
+    assert args_lines[1] == "ARGS:--epochs 5 --resume /ck/latest_model.ckpt"
+    assert args_lines[2] == "ARGS:--epochs 5 --resume /ck/latest_model.ckpt"
+    assert marker.read_text() == "3"
+    assert proc.stderr.count("WARN: training exited") == 2
+
+
+def test_max_restarts_exhausted_fails_with_last_rc(tmp_path):
+    stub = tmp_path / "stub.py"
+    stub.write_text("import sys; sys.exit(7)\n")
+    env = {
+        "PATH": os.environ["PATH"],
+        "TRAINING_SCRIPT": str(stub),
+        "MAX_RESTARTS": "2",
+    }
+    proc = subprocess.run(
+        ["bash", ENTRYPOINT], env=env, capture_output=True, text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 7
+    assert "giving up" in proc.stderr
+    assert proc.stderr.count("WARN: training exited") == 2
+
+
+def test_restart_resume_dir_follows_script_args(tmp_path):
+    """--checkpoint-dir inside SCRIPT_ARGS wins over $CHECKPOINT_DIR, so
+    the retry resumes from where the trainer actually writes."""
+    stub = tmp_path / "stub.py"
+    marker = tmp_path / "attempts"
+    stub.write_text(
+        "import pathlib, sys\n"
+        f"m = pathlib.Path({str(marker)!r})\n"
+        "n = int(m.read_text()) if m.exists() else 0\n"
+        "m.write_text(str(n + 1))\n"
+        "print('ARGS:' + ' '.join(sys.argv[1:]))\n"
+        "sys.exit(1 if n < 1 else 0)\n"
+    )
+    env = {
+        "PATH": os.environ["PATH"],
+        "TRAINING_SCRIPT": str(stub),
+        "SCRIPT_ARGS": "--checkpoint-dir /mnt/ckpt --epochs 9",
+        "MAX_RESTARTS": "2",
+        "CHECKPOINT_DIR": "/wrong",
+    }
+    proc = subprocess.run(
+        ["bash", ENTRYPOINT], env=env, capture_output=True, text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    args_lines = [
+        l for l in proc.stdout.splitlines() if l.startswith("ARGS:")
+    ]
+    assert args_lines[1].endswith("--resume /mnt/ckpt/latest_model.ckpt")
+    assert "/wrong" not in proc.stdout
+
+
+def test_restart_loop_does_not_fight_signals(tmp_path):
+    """A child killed by a signal (rc > 128) must NOT be restarted — the
+    orchestrator is tearing the pod down."""
+    stub = tmp_path / "stub.py"
+    stub.write_text(
+        "import os, signal\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n"
+    )
+    env = {
+        "PATH": os.environ["PATH"],
+        "TRAINING_SCRIPT": str(stub),
+        "MAX_RESTARTS": "3",
+    }
+    proc = subprocess.run(
+        ["bash", ENTRYPOINT], env=env, capture_output=True, text=True,
+        timeout=60,
+    )
+    assert proc.returncode > 128
+    assert "not restarting" in proc.stderr
+    assert "WARN: training exited" not in proc.stderr
