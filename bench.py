@@ -239,11 +239,17 @@ def main():
 
     results: dict = {}
     for name in names:
-        try:
-            results[name] = run_model(name, args)
-        except Exception as e:  # noqa: BLE001 - one failure must not kill the line
-            print(f"bench: {name} FAILED: {e}", file=sys.stderr)
-            results[name] = {"error": str(e)}
+        for attempt in (1, 2):  # the tunneled device link flakes rarely;
+            # one retry keeps a transient from blanking a model's entry
+            try:
+                results[name] = run_model(name, args)
+                break
+            except Exception as e:  # noqa: BLE001 - must not kill the line
+                print(
+                    f"bench: {name} FAILED (attempt {attempt}): {e}",
+                    file=sys.stderr,
+                )
+                results[name] = {"error": str(e)}
 
     # the driver metric stays ResNet-50 (BASELINE.json); fall back to the
     # first successful model when it wasn't benchmarked

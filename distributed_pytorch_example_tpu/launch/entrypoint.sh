@@ -86,15 +86,21 @@ for arg in ${SCRIPT_ARGS}; do
   if [ "${prev}" = "--checkpoint-dir" ]; then
     ckpt_dir="${arg}"
   fi
+  case "${arg}" in
+    --checkpoint-dir=*) ckpt_dir="${arg#--checkpoint-dir=}" ;;
+  esac
   prev="${arg}"
 done
 resume_ckpt="${ckpt_dir}/latest_model.ckpt"
 
 # run python in the background so this (possibly PID-1) shell can forward
-# termination signals instead of absorbing them
+# termination signals instead of absorbing them; a signal landing while no
+# child is running (the backoff sleep) must still stop the loop
 child=0
+terminating=0
 forward() {
   sig="$1"
+  terminating=1
   if [ "${child}" -ne 0 ]; then
     kill -s "${sig}" "${child}" 2>/dev/null || true
   fi
@@ -123,8 +129,8 @@ while true; do
   if [ "${rc}" -eq 0 ]; then
     exit 0
   fi
-  if [ "${rc}" -gt 128 ]; then
-    # killed by a signal (orchestrator teardown): do not fight it
+  if [ "${rc}" -gt 128 ] || [ "${terminating}" -ne 0 ]; then
+    # killed by a signal / teardown in progress: do not fight it
     echo "INFO: training terminated by signal (rc=${rc}); not restarting" >&2
     exit "${rc}"
   fi
@@ -142,4 +148,8 @@ while true; do
   fi
   resume_args="--resume ${resume_ckpt}"
   sleep 2
+  if [ "${terminating}" -ne 0 ]; then
+    echo "INFO: teardown signal during backoff; not restarting" >&2
+    exit 1
+  fi
 done

@@ -79,7 +79,7 @@ class LlamaBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     use_flash: Optional[bool] = None
     seq_axis: Optional[str] = None
-    sp_mode: str = "ulysses"  # GQA needs the all-to-all SP path
+    sp_mode: str = "ulysses"  # default; ring also serves GQA (chunk-local expand)
     decode: bool = False
     moe_experts: int = 0  # >0: Mixtral-style SwiGLU-expert MoE MLP
     moe_top_k: int = 2

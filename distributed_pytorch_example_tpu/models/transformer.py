@@ -119,12 +119,6 @@ class MultiHeadAttention(nn.Module):
                 use_flash=self.use_flash,
             )
         elif ring_mesh is not None:
-            if kv_heads != self.num_heads:
-                raise NotImplementedError(
-                    "GQA is not supported on the ring-attention path "
-                    "(kv heads break the ring's equal-head einsums); use "
-                    "sp_mode='ulysses'"
-                )
             from distributed_pytorch_example_tpu.ops.ring_attention import (
                 ring_attention_sharded,
             )

@@ -55,6 +55,34 @@ def _pos_mask(idx, src, s_loc):
     return (q_pos >= k_pos)[None, :, None, :]
 
 
+def _expand_gqa(q, k, v):
+    """Repeat kv heads up to q heads for the chunk einsums (GQA).
+
+    Chunk-local and transient — O(S_chunk) extra memory per fold, unlike
+    Ulysses' whole-sequence replication. q-head n reads kv-head
+    n // group, matching the flash kernel's BlockSpec routing.
+    """
+    group = q.shape[2] // k.shape[2]
+    if group == 1:
+        return k, v, 1
+    return (
+        jnp.repeat(k, group, axis=2),
+        jnp.repeat(v, group, axis=2),
+        group,
+    )
+
+
+def _collapse_gqa(dk, dv, group):
+    """Sum per-q-head kv grads back onto their kv head (GQA backward)."""
+    if group == 1:
+        return dk, dv
+    b, s, n, h = dk.shape
+    return (
+        dk.reshape(b, s, n // group, group, h).sum(3),
+        dv.reshape(b, s, n // group, group, h).sum(3),
+    )
+
+
 def _chunk_fwd_xla(q, k, v, mask, scale, causal, idx, src):
     """Normalized chunk attention + lse in XLA ops; (B,S,N,H) ring layout.
 
@@ -64,6 +92,7 @@ def _chunk_fwd_xla(q, k, v, mask, scale, causal, idx, src):
     padded) emit lse ≈ NEG_INF, so their garbage output vanishes in the
     lse merge.
     """
+    k, v, _ = _expand_gqa(q, k, v)
     logits = jnp.einsum(
         "bqnh,bknh->bqnk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
@@ -80,6 +109,7 @@ def _chunk_fwd_xla(q, k, v, mask, scale, causal, idx, src):
 
 def _chunk_bwd_xla(q, k, v, mask, g, lse, delta, scale, causal, idx, src):
     """Chunk grads from the saved global lse; all math in float32."""
+    k, v, group = _expand_gqa(q, k, v)
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
     gf = g.astype(jnp.float32)
     logits = jnp.einsum("bqnh,bknh->bqnk", qf, kf) * scale
@@ -98,6 +128,7 @@ def _chunk_bwd_xla(q, k, v, mask, g, lse, delta, scale, causal, idx, src):
     ds = p * (dp - delta) * scale
     dq = jnp.einsum("bqnk,bknh->bqnh", ds, kf)
     dk = jnp.einsum("bqnk,bqnh->bknh", ds, qf)
+    dk, dv = _collapse_gqa(dk, dv, group)
     return dq, dk, dv
 
 
@@ -435,6 +466,11 @@ def ring_attention(
     """
     if softmax_scale is None:
         softmax_scale = q.shape[-1] ** -0.5
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"q heads ({q.shape[2]}) must be a multiple of kv heads "
+            f"({k.shape[2]}) for GQA"
+        )
     if kv_mask is not None and kv_mask.shape != (q.shape[0], k.shape[1]):
         raise ValueError(
             f"kv_mask shape {kv_mask.shape} != (batch, seq_local) "
@@ -484,9 +520,10 @@ def ring_attention_sharded(
     """
     batch_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
     heads = q.shape[2]
-    use_heads_axis = (
-        mesh.shape.get(heads_axis, 1) > 1 and heads % mesh.shape[heads_axis] == 0
-    )
+    tp = mesh.shape.get(heads_axis, 1)
+    # with GQA the k/v heads dim is smaller; all three arrays share one
+    # spec, so the heads axis engages only when BOTH divide
+    use_heads_axis = tp > 1 and heads % tp == 0 and k.shape[2] % tp == 0
     spec = P(batch_axes, seq_axis, heads_axis if use_heads_axis else None, None)
     kernel = functools.partial(
         ring_attention,

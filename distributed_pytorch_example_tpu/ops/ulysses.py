@@ -79,7 +79,7 @@ def ulysses_attention(
         raise ValueError(
             f"ulysses needs q heads ({q.shape[2]}) divisible by the "
             f"sequence axis size ({p}); shrink the sequence axis, or use "
-            f"ring attention (equal-head MHA models only)"
+            f"ring attention (no head-divisibility constraint)"
         )
     kv_heads = k.shape[2]
     if kv_heads % p:
@@ -87,8 +87,8 @@ def ulysses_attention(
             raise ValueError(
                 f"ulysses needs kv heads ({kv_heads}) to divide or be "
                 f"divided by the sequence axis size ({p}); shrink the "
-                f"sequence axis (ring attention is only an alternative "
-                f"for equal-head MHA models)"
+                f"sequence axis, or use ring attention (serves GQA with "
+                f"chunk-local kv expansion)"
             )
         # GQA with fewer kv heads than devices: replicate kv heads up to
         # the axis size (each q-head group still sees its correct kv head

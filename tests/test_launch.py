@@ -212,3 +212,32 @@ def test_restart_loop_does_not_fight_signals(tmp_path):
     assert proc.returncode > 128
     assert "not restarting" in proc.stderr
     assert "WARN: training exited" not in proc.stderr
+
+
+def test_restart_resume_dir_equals_form(tmp_path):
+    """--checkpoint-dir=PATH (argparse's '=' spelling) is parsed too."""
+    stub = tmp_path / "stub.py"
+    marker = tmp_path / "attempts"
+    stub.write_text(
+        "import pathlib, sys\n"
+        f"m = pathlib.Path({str(marker)!r})\n"
+        "n = int(m.read_text()) if m.exists() else 0\n"
+        "m.write_text(str(n + 1))\n"
+        "print('ARGS:' + ' '.join(sys.argv[1:]))\n"
+        "sys.exit(1 if n < 1 else 0)\n"
+    )
+    env = {
+        "PATH": os.environ["PATH"],
+        "TRAINING_SCRIPT": str(stub),
+        "SCRIPT_ARGS": "--checkpoint-dir=/mnt/eq --epochs 9",
+        "MAX_RESTARTS": "2",
+    }
+    proc = subprocess.run(
+        ["bash", ENTRYPOINT], env=env, capture_output=True, text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    args_lines = [
+        l for l in proc.stdout.splitlines() if l.startswith("ARGS:")
+    ]
+    assert args_lines[1].endswith("--resume /mnt/eq/latest_model.ckpt")

@@ -243,3 +243,136 @@ def test_flash_folds_non_512_divisible_shard(devices):
     expected = _xla_attention(q, k, v, None, None, True, scale)
     got = ring(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gqa_matches_full_attention(devices, causal):
+    """Grouped-query attention on the ring: kv chunks carry only kv_heads
+    and are expanded chunk-locally (O(S_chunk), unlike Ulysses' whole-
+    sequence replication); must match the dense GQA reference."""
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((2, 256, 4, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 256, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 256, 2, 32)), jnp.float32)
+    scale = q.shape[-1] ** -0.5
+    expected = _xla_attention(q, k, v, None, None, causal, scale)
+    got = ring_attention_sharded(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_gqa_grads_match_full_attention(devices):
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((2, 128, 4, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 128, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 128, 2, 32)), jnp.float32)
+    scale = q.shape[-1] ** -0.5
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, None, None, True, scale) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh, causal=True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for gr, gg, name in zip(g_ref, g_ring, "qkv"):
+        assert gg.shape == gr.shape, name  # dk/dv stay at kv_heads
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gr), atol=1e-4, err_msg=f"d{name}"
+        )
+
+
+def test_indivisible_gqa_heads_rejected(devices):
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    q = jnp.zeros((2, 128, 4, 32))
+    kv = jnp.zeros((2, 128, 3, 32))  # 4 % 3 != 0
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        ring_attention_sharded(q, kv, kv, mesh, causal=True)
+
+
+def test_llama_trains_with_ring_sp(devices):
+    """The LLaMA family (GQA + RoPE) on the RING path under a sequence
+    mesh: the combination the r2 code refused (pointing users at Ulysses)
+    now trains, giving GQA models O(S_local) ring memory for long
+    context."""
+    import optax
+
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
+
+    mesh = make_mesh(MeshSpec(data=4, sequence=2))
+    model = dpx.models.get_model(
+        "llama", vocab_size=64, max_len=32, model_dim=32, num_layers=2,
+        num_heads=4, num_kv_heads=2, mlp_dim=64, seq_axis="sequence",
+        sp_mode="ring", use_flash=False, logits_mode="hidden",
+    )
+    trainer = dpx.train.Trainer(
+        model, CausalLMTask(), optax.adam(1e-2),
+        partitioner=dpx.parallel.data_parallel(mesh),
+    )
+    tokens = np.random.default_rng(0).integers(0, 64, (8, 16)).astype(np.int32)
+    sharding = trainer.partitioner.batch_sharding()
+    batch = {"tokens": jax.make_array_from_process_local_data(sharding, tokens)}
+    with mesh:
+        trainer.init(batch["tokens"])
+        losses = []
+        state = trainer.state
+        for _ in range(4):
+            state, metrics = trainer.train_step(state, batch)
+            losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gqa_flash_folds_match_full_attention(devices, causal):
+    """GQA through the ring's FLASH chunk path (interpret mode) — the
+    combination real TPUs auto-select: the kernel's n//group kv routing
+    composed with the ring's lax.switch variants and travelling dk/dv
+    accumulators must match the dense GQA reference, values and grads."""
+    import functools
+
+    from distributed_pytorch_example_tpu.ops.ring_attention import (
+        ring_attention,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((2, 512, 4, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 512, 2, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 512, 2, 64)), jnp.float32)
+    scale = q.shape[-1] ** -0.5
+    spec = P("data", "sequence", None, None)
+    with mesh:
+        ring = jax.shard_map(
+            functools.partial(
+                ring_attention, axis_name="sequence", causal=causal,
+                use_flash=True, flash_interpret=True,
+            ),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,  # see test_flash_folds_* note above
+        )
+        expected = _xla_attention(q, k, v, None, None, causal, scale)
+        got = ring(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), atol=2e-5
+        )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                _xla_attention(q, k, v, None, None, causal, scale) ** 2
+            )
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring(q, k, v) ** 2)
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for gr, gg, name in zip(g_ref, g_ring, "qkv"):
+        assert gg.shape == gr.shape, name  # dk/dv stay at kv_heads
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gr), atol=2e-3, err_msg=f"d{name}"
+        )

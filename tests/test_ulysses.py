@@ -57,7 +57,8 @@ def test_grads_match_full_attention(devices, causal):
 
 
 def test_gqa_under_ulysses(devices):
-    """GQA works through the all-to-all path (ring cannot serve it)."""
+    """GQA works through the all-to-all path (ring serves it too — see
+    tests/test_ring_attention.py — with different memory trade-offs)."""
     mesh = make_mesh(MeshSpec(data=2, sequence=4))
     q, _, _ = make_qkv(heads=8)
     _, k, v = make_qkv(heads=4, seed=1)
