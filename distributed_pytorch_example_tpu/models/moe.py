@@ -33,6 +33,110 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def moe_apply(
+    x,
+    router_logits,
+    params: dict,
+    *,
+    top_k: int,
+    capacity_factor: float,
+    dtype=jnp.float32,
+    swiglu: bool = False,
+):
+    """The MoE layer as a pure function: ``(y, aux)`` from explicit params.
+
+    The single source of truth for the routing/dispatch math — the flax
+    :class:`MoEMlpBlock` wraps it (adding param creation and sow), and the
+    layer-stacked pipelined decoder (models/stacked.py) calls it directly
+    with scan-sliced params, so both paths share one implementation.
+
+    Args:
+      x: (B, S, D) activations.
+      router_logits: (B, S, E) float32 routing logits (callers own the
+        router projection so their param paths stay stable).
+      params: ``up_kernel`` (E, D, M), ``down_kernel`` (E, M, D); gelu
+        experts add ``up_bias``/``down_bias``, SwiGLU experts add
+        ``gate_kernel``.
+
+    Returns ``(y, aux)`` with RAW (unweighted) scalars in ``aux``:
+    ``load_balancing``, ``router_z``, ``dropped_fraction``.
+    """
+    batch, seq, dim = x.shape
+    n_exp = router_logits.shape[-1]
+    k = top_k
+    if not 1 <= k <= n_exp:
+        raise ValueError(f"top_k {k} must be in [1, num_experts {n_exp}]")
+    capacity = max(1, math.ceil(k * seq * capacity_factor / n_exp))
+
+    router_logits = router_logits.astype(jnp.float32)
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    top_probs, top_idx = lax.top_k(probs, k)  # (B, S, K)
+    if k > 1:
+        # GShard: gates renormalized over the selected experts
+        gates = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
+    else:
+        gates = top_probs  # Switch: raw router prob
+
+    onehot_k = jax.nn.one_hot(top_idx, n_exp, dtype=jnp.float32)
+    # Switch load-balancing loss, f_e from first choices only
+    tokens_per_expert = onehot_k[:, :, 0].mean(axis=(0, 1))  # (E,)
+    prob_per_expert = probs.mean(axis=(0, 1))  # (E,)
+    aux_lb = n_exp * jnp.sum(tokens_per_expert * prob_per_expert)
+    z = jax.nn.logsumexp(router_logits, axis=-1)  # (B, S)
+    aux_z = jnp.mean(jnp.square(z))
+
+    # capacity-slot assignment: cumulative position of each (choice,
+    # token) in its expert's queue, ordered k-major so every first
+    # choice outranks every second choice; slot >= capacity one_hots to
+    # all-zeros, which IS the drop (token rides the residual)
+    oh_flat = onehot_k.transpose(0, 2, 1, 3).reshape(
+        batch, k * seq, n_exp
+    )  # (B, K*S, E), k-major priority order
+    pos = (jnp.cumsum(oh_flat, axis=1) - 1.0) * oh_flat
+    slot = (
+        jnp.sum(pos, axis=-1)
+        .reshape(batch, k, seq)
+        .transpose(0, 2, 1)
+    )  # (B, S, K)
+    dispatch_k = (
+        onehot_k[..., None]
+        * jax.nn.one_hot(
+            slot.astype(jnp.int32), capacity, dtype=jnp.float32
+        )[:, :, :, None, :]
+    )  # (B, S, K, E, C) one-hot; slots are disjoint across k
+    dispatch = jnp.sum(dispatch_k, axis=2)  # (B, S, E, C)
+    combine = jnp.sum(
+        dispatch_k * gates[..., None, None], axis=2
+    )  # weighted return path
+    kept = jnp.sum(dispatch)  # each kept (token, choice) contributes 1
+    dropped_fraction = 1.0 - kept / (batch * seq * k)
+
+    w_up = params["up_kernel"].astype(dtype)
+    w_down = params["down_kernel"].astype(dtype)
+    # dispatch → expert MLP → combine: all einsums, XLA inserts the
+    # all-to-alls when 'expert' spans devices
+    expert_in = jnp.einsum(
+        "bsec,bsd->ebcd", dispatch.astype(dtype), x
+    )  # (E, B, C, D)
+    up = jnp.einsum("ebcd,edf->ebcf", expert_in, w_up)
+    if swiglu:
+        w_gate = params["gate_kernel"].astype(dtype)
+        h = nn.silu(jnp.einsum("ebcd,edf->ebcf", expert_in, w_gate)) * up
+    else:
+        h = nn.gelu(up + params["up_bias"].astype(dtype)[:, None, None, :])
+    expert_out = jnp.einsum("ebcf,efd->ebcd", h, w_down)
+    if not swiglu:
+        expert_out = (
+            expert_out + params["down_bias"].astype(dtype)[:, None, None, :]
+        )
+    y = jnp.einsum("bsec,ebcd->bsd", combine.astype(dtype), expert_out)
+    return y, {
+        "load_balancing": aux_lb,
+        "router_z": aux_z,
+        "dropped_fraction": dropped_fraction,
+    }
+
+
 class MoEMlpBlock(nn.Module):
     """Drop-in replacement for models.transformer.MlpBlock."""
 
@@ -51,125 +155,67 @@ class MoEMlpBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
-        batch, seq, dim = x.shape
-        n_exp, k = self.num_experts, self.top_k
-        if not 1 <= k <= n_exp:
-            raise ValueError(f"top_k {k} must be in [1, num_experts {n_exp}]")
-        capacity = max(1, math.ceil(k * seq * self.capacity_factor / n_exp))
+        _, _, dim = x.shape
+        n_exp = self.num_experts
+        lecun_e = nn.initializers.lecun_normal(batch_axis=(0,))
 
-        # routing in float32: small tensors, and router stability matters
+        # routing in float32: small tensors, and router stability matters;
+        # the Dense child keeps the historical 'router/kernel' param path
         router_logits = nn.Dense(n_exp, dtype=jnp.float32, name="router")(
             x.astype(jnp.float32)
         )  # (B, S, E)
-        probs = jax.nn.softmax(router_logits, axis=-1)
-        top_probs, top_idx = lax.top_k(probs, k)  # (B, S, K)
-        if k > 1:
-            # GShard: gates renormalized over the selected experts
-            gates = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
-        else:
-            gates = top_probs  # Switch: raw router prob
 
-        onehot_k = jax.nn.one_hot(top_idx, n_exp, dtype=jnp.float32)
-        # Switch load-balancing loss, f_e from first choices only
-        tokens_per_expert = onehot_k[:, :, 0].mean(axis=(0, 1))  # (E,)
-        prob_per_expert = probs.mean(axis=(0, 1))  # (E,)
-        aux = n_exp * jnp.sum(tokens_per_expert * prob_per_expert)
+        # expert weights: leading expert dim is the EP sharding target.
+        # Bias convention mirrors the dense MLP each expert replaces: gelu
+        # experts (transformer MlpBlock) carry biases, SwiGLU experts
+        # (llama SwiGluMlp, Mixtral) are bias-free throughout.
+        params = {
+            "up_kernel": self.param(
+                "up_kernel", lecun_e, (n_exp, dim, self.mlp_dim)
+            ),
+            "down_kernel": self.param(
+                "down_kernel", lecun_e, (n_exp, self.mlp_dim, dim)
+            ),
+        }
+        if self.swiglu:
+            params["gate_kernel"] = self.param(
+                "gate_kernel", lecun_e, (n_exp, dim, self.mlp_dim)
+            )
+        else:
+            params["up_bias"] = self.param(
+                "up_bias", nn.initializers.zeros_init(),
+                (n_exp, self.mlp_dim),
+            )
+            params["down_bias"] = self.param(
+                "down_bias", nn.initializers.zeros_init(), (n_exp, dim)
+            )
+
+        out, aux = moe_apply(
+            x, router_logits, params, top_k=self.top_k,
+            capacity_factor=self.capacity_factor, dtype=self.dtype,
+            swiglu=self.swiglu,
+        )
         self.sow(
             "losses", "load_balancing",
-            self.aux_loss_weight * aux,
+            self.aux_loss_weight * aux["load_balancing"],
             reduce_fn=lambda a, b: a + b,
             init_fn=lambda: jnp.zeros((), jnp.float32),
         )
-        z = jax.nn.logsumexp(router_logits, axis=-1)  # (B, S)
         self.sow(
             "losses", "router_z",
-            self.z_loss_weight * jnp.mean(jnp.square(z)),
+            self.z_loss_weight * aux["router_z"],
             reduce_fn=lambda a, b: a + b,
             init_fn=lambda: jnp.zeros((), jnp.float32),
         )
-
-        # capacity-slot assignment: cumulative position of each (choice,
-        # token) in its expert's queue, ordered k-major so every first
-        # choice outranks every second choice; slot >= capacity one_hots to
-        # all-zeros, which IS the drop (token rides the residual)
-        oh_flat = onehot_k.transpose(0, 2, 1, 3).reshape(
-            batch, k * seq, n_exp
-        )  # (B, K*S, E), k-major priority order
-        pos = (jnp.cumsum(oh_flat, axis=1) - 1.0) * oh_flat
-        slot = (
-            jnp.sum(pos, axis=-1)
-            .reshape(batch, k, seq)
-            .transpose(0, 2, 1)
-        )  # (B, S, K)
-        dispatch_k = (
-            onehot_k[..., None]
-            * jax.nn.one_hot(
-                slot.astype(jnp.int32), capacity, dtype=jnp.float32
-            )[:, :, :, None, :]
-        )  # (B, S, K, E, C) one-hot; slots are disjoint across k
-        dispatch = jnp.sum(dispatch_k, axis=2)  # (B, S, E, C)
-        combine = jnp.sum(
-            dispatch_k * gates[..., None, None], axis=2
-        )  # weighted return path
-
         # observability: capacity-dropped (token, choice) pairs ride the
         # residual silently — surface the fraction so a mis-tuned
         # capacity_factor shows up in metrics (train/tasks.py averages the
-        # sown values into `moe_dropped_fraction`), not as mysterious loss
-        # degradation
-        if not self.is_initializing():  # init must not bake a stale value
-            kept = jnp.sum(dispatch)  # each kept pair contributes exactly 1
+        # sown values into `moe_dropped_fraction`); init must not bake a
+        # stale value
+        if not self.is_initializing():
             self.sow(
-                "moe_metrics", "dropped_fraction",
-                1.0 - kept / (batch * seq * k),
+                "moe_metrics", "dropped_fraction", aux["dropped_fraction"]
             )
-
-        # expert weights: leading expert dim is the EP sharding target.
-        # Bias convention mirrors the dense MLP each expert replaces:
-        # gelu experts (transformer MlpBlock) carry biases, SwiGLU experts
-        # (llama SwiGluMlp, Mixtral) are bias-free throughout.
-        w_up = self.param(
-            "up_kernel",
-            nn.initializers.lecun_normal(batch_axis=(0,)),
-            (n_exp, dim, self.mlp_dim),
-        ).astype(self.dtype)
-        w_down = self.param(
-            "down_kernel",
-            nn.initializers.lecun_normal(batch_axis=(0,)),
-            (n_exp, self.mlp_dim, dim),
-        ).astype(self.dtype)
-        b_up = b_down = None
-        if not self.swiglu:
-            b_up = self.param(
-                "up_bias", nn.initializers.zeros_init(), (n_exp, self.mlp_dim)
-            ).astype(self.dtype)
-            b_down = self.param(
-                "down_bias", nn.initializers.zeros_init(), (n_exp, dim)
-            ).astype(self.dtype)
-
-        # dispatch → expert MLP → combine: all einsums, XLA inserts the
-        # all-to-alls when 'expert' spans devices
-        expert_in = jnp.einsum(
-            "bsec,bsd->ebcd", dispatch.astype(self.dtype), x
-        )  # (E, B, C, D)
-        up = jnp.einsum("ebcd,edf->ebcf", expert_in, w_up)
-        if self.swiglu:
-            w_gate = self.param(
-                "gate_kernel",
-                nn.initializers.lecun_normal(batch_axis=(0,)),
-                (n_exp, dim, self.mlp_dim),
-            ).astype(self.dtype)
-            h = nn.silu(
-                jnp.einsum("ebcd,edf->ebcf", expert_in, w_gate)
-            ) * up
-        else:
-            h = nn.gelu(up + b_up[:, None, None, :])
-        expert_out = jnp.einsum("ebcf,efd->ebcd", h, w_down)
-        if not self.swiglu:
-            expert_out = expert_out + b_down[:, None, None, :]
-        out = jnp.einsum(
-            "bsec,ebcd->bsd", combine.astype(self.dtype), expert_out
-        )
         if self.dropout_rate:
             out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
         return out

@@ -72,22 +72,44 @@ def _pipe_size(pipe_axis) -> int:
     return mesh.shape.get(pipe_axis, 1)
 
 
-def _run_stacked(mod, params, x, block):
+def _run_stacked(mod, params, x, block, aux_init=None):
     """Shared execution for layer-stacked decoders: scan or GPipe.
 
     ``mod`` provides num_layers / dtype / remat / pipe_axis /
-    pipe_microbatches fields.
+    pipe_microbatches fields. With ``aux_init`` (a pytree of f32 scalar
+    zeros) ``block`` returns ``(h, aux)`` per layer; the return becomes
+    ``(out, aux_sums, n_batches)`` where aux_sums total every
+    (layer, batch-pass) contribution and ``n_batches`` is how many passes
+    summed in (1 for the full-batch scan, n_micro under GPipe — routing
+    statistics are per microbatch there, gradient-accumulation semantics).
     """
     x = x.astype(mod.dtype)
     if mod.remat:
         block = jax.checkpoint(block, prevent_cse=False)
+
+    def scan_layers(h, layer_params, aux0):
+        if aux_init is None:
+            def body(hh, lp):
+                return block(lp, hh), None
+
+            out, _ = lax.scan(body, h, layer_params)
+            return out
+
+        def body(carry, lp):
+            hh, acc = carry
+            hh, aux = block(lp, hh)
+            acc = jax.tree_util.tree_map(lambda a, b: a + b, acc, aux)
+            return (hh, acc), None
+
+        (out, acc), _ = lax.scan(body, (h, aux0), layer_params)
+        return out, acc
+
     pipe = _pipe_size(mod.pipe_axis)
     if pipe <= 1:
-        def body(h, lp):
-            return block(lp, h), None
-
-        out, _ = lax.scan(body, x, params)
-        return out
+        if aux_init is None:
+            return scan_layers(x, params, None)
+        out, acc = scan_layers(x, params, aux_init)
+        return out, acc, 1.0
 
     from distributed_pytorch_example_tpu.parallel.pipeline import gpipe
     from distributed_pytorch_example_tpu.runtime.mesh import (
@@ -107,17 +129,36 @@ def _run_stacked(mod, params, x, block):
     )
 
     def stage_fn(stage_params, h):
-        def body(hh, lp):
-            return block(lp, hh), None
+        if aux_init is None:
+            return scan_layers(h, stage_params, None)
+        from distributed_pytorch_example_tpu.parallel.api import pvary_like
 
-        out, _ = lax.scan(body, h, stage_params)
-        return out
+        # constant aux zeros must carry the pipe vma the per-layer
+        # outputs acquire inside the manual region
+        return scan_layers(
+            h, stage_params, pvary_like(aux_init, h, (mod.pipe_axis,))
+        )
 
-    return gpipe(stage_fn, sp, x, mesh, n_micro, pipe_axis=mod.pipe_axis)
+    result = gpipe(
+        stage_fn, sp, x, mesh, n_micro, pipe_axis=mod.pipe_axis,
+        aux_init=aux_init,
+    )
+    if aux_init is None:
+        return result
+    out, aux_sum = result
+    return out, aux_sum, float(n_micro)
 
 
 class StackedDecoder(nn.Module):
-    """Homogeneous pre-LN transformer blocks with layer-stacked params."""
+    """Homogeneous pre-LN transformer blocks with layer-stacked params.
+
+    ``moe_experts > 0`` swaps EVERY block's dense MLP for a gelu-expert
+    MoE layer (models/moe.py semantics) — every-block cadence keeps the
+    layer stack homogeneous for the scan/pipeline; the auxiliary
+    load-balancing/z losses (and the drop-fraction metric) are sown like
+    the per-layer MoEMlpBlock's, with the GPipe schedule excluding
+    bubble-tick garbage from them (parallel/pipeline.py aux_init).
+    """
 
     num_layers: int
     num_heads: int
@@ -131,12 +172,19 @@ class StackedDecoder(nn.Module):
     remat: bool = False
     pipe_axis: Optional[str] = None  # mesh axis for pipeline stages
     pipe_microbatches: int = 0  # 0 = auto (largest k*pipe <= 4*pipe | batch)
+    moe_experts: int = 0  # >0: MoE MLP on EVERY block (gelu experts)
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 0.01
+    moe_z_loss_weight: float = 1e-3
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         L, D, M = self.num_layers, self.model_dim, self.mlp_dim
         F = self.num_heads * self.head_dim
+        E = self.moe_experts
         lecun = nn.initializers.lecun_normal()
+        lecun_e = nn.initializers.lecun_normal(batch_axis=(0,))
         zeros, ones = nn.initializers.zeros, nn.initializers.ones
 
         def stacked(name, init, shape):
@@ -155,16 +203,92 @@ class StackedDecoder(nn.Module):
             "o_bias": stacked("o_bias", zeros, (D,)),
             "ln2_scale": stacked("ln2_scale", ones, (D,)),
             "ln2_bias": stacked("ln2_bias", zeros, (D,)),
+        }
+        if E:
+            params.update({
+                "router_kernel": stacked("router_kernel", lecun, (D, E)),
+                "router_bias": stacked("router_bias", zeros, (E,)),
+                "moe_up_kernel": stacked("moe_up_kernel", lecun_e, (E, D, M)),
+                "moe_up_bias": stacked("moe_up_bias", zeros, (E, M)),
+                "moe_down_kernel": stacked(
+                    "moe_down_kernel", lecun_e, (E, M, D)
+                ),
+                "moe_down_bias": stacked("moe_down_bias", zeros, (E, D)),
+            })
+            return self._run_moe(params, x)
+        params.update({
             "up_kernel": stacked("up_kernel", lecun, (D, M)),
             "up_bias": stacked("up_bias", zeros, (M,)),
             "down_kernel": stacked("down_kernel", lecun, (M, D)),
             "down_bias": stacked("down_bias", zeros, (D,)),
-        }
-
+        })
         return _run_stacked(self, params, x, self._block_fn(x.shape))
 
-    def _block_fn(self, x_shape):
-        """(layer_params, h) -> h, pre-LN block in compute dtype."""
+    def _run_moe(self, params, x):
+        """MoE stack: scan or GPipe, aux losses gated past bubble ticks."""
+        aux_zero = {
+            "load_balancing": jnp.zeros((), jnp.float32),
+            "router_z": jnp.zeros((), jnp.float32),
+            "dropped_fraction": jnp.zeros((), jnp.float32),
+        }
+        out, aux_sum, n_batches = _run_stacked(
+            self, params, x, self._moe_block_fn(x.shape), aux_init=aux_zero
+        )
+        # aux semantics parity with the per-layer MoEMlpBlock: losses SUM
+        # over layers, batch means; drop fraction averages over layers
+        lb = aux_sum["load_balancing"] / n_batches
+        rz = aux_sum["router_z"] / n_batches
+        self.sow(
+            "losses", "load_balancing", self.moe_aux_loss_weight * lb,
+            reduce_fn=lambda a, b: a + b,
+            init_fn=lambda: jnp.zeros((), jnp.float32),
+        )
+        self.sow(
+            "losses", "router_z", self.moe_z_loss_weight * rz,
+            reduce_fn=lambda a, b: a + b,
+            init_fn=lambda: jnp.zeros((), jnp.float32),
+        )
+        if not self.is_initializing():
+            self.sow(
+                "moe_metrics", "dropped_fraction",
+                aux_sum["dropped_fraction"] / (n_batches * self.num_layers),
+            )
+        return out
+
+    def _moe_block_fn(self, x_shape):
+        """(layer_params, h) -> (h, aux); attention + gelu-expert MoE."""
+        from distributed_pytorch_example_tpu.models.moe import moe_apply
+
+        attn = self._attn_fn(x_shape)
+        dtype = self.dtype
+        eps = self.layer_norm_epsilon
+        top_k = self.moe_top_k
+        cf = self.moe_capacity_factor
+
+        def block(lp, h):
+            h = attn(lp, h)
+            b = _layer_norm(h, lp["ln2_scale"], lp["ln2_bias"], eps, dtype)
+            router_logits = (
+                b.astype(jnp.float32)
+                @ lp["router_kernel"].astype(jnp.float32)
+                + lp["router_bias"].astype(jnp.float32)
+            )
+            y, aux = moe_apply(
+                b, router_logits,
+                {
+                    "up_kernel": lp["moe_up_kernel"],
+                    "up_bias": lp["moe_up_bias"],
+                    "down_kernel": lp["moe_down_kernel"],
+                    "down_bias": lp["moe_down_bias"],
+                },
+                top_k=top_k, capacity_factor=cf, dtype=dtype,
+            )
+            return h + y, aux
+
+        return block
+
+    def _attn_fn(self, x_shape):
+        """(layer_params, h) -> h after the pre-LN attention residual."""
         seq = x_shape[1]
         dtype = self.dtype
         eps = self.layer_norm_epsilon
@@ -174,7 +298,7 @@ class StackedDecoder(nn.Module):
         def dense(z, kernel, bias):
             return z @ kernel.astype(dtype) + bias.astype(dtype)
 
-        def block(lp, h):
+        def attn_part(lp, h):
             a = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"], eps, dtype)
             q = dense(a, lp["q_kernel"], lp["q_bias"]).reshape(heads_shape)
             k = dense(a, lp["k_kernel"], lp["k_bias"]).reshape(heads_shape)
@@ -184,7 +308,21 @@ class StackedDecoder(nn.Module):
                 use_flash=self.use_flash,
             )
             attn = attn.reshape(*h.shape[:-1], -1)
-            h = h + dense(attn, lp["o_kernel"], lp["o_bias"])
+            return h + dense(attn, lp["o_kernel"], lp["o_bias"])
+
+        return attn_part
+
+    def _block_fn(self, x_shape):
+        """(layer_params, h) -> h, pre-LN block in compute dtype."""
+        attn = self._attn_fn(x_shape)
+        dtype = self.dtype
+        eps = self.layer_norm_epsilon
+
+        def dense(z, kernel, bias):
+            return z @ kernel.astype(dtype) + bias.astype(dtype)
+
+        def block(lp, h):
+            h = attn(lp, h)
             b = _layer_norm(h, lp["ln2_scale"], lp["ln2_bias"], eps, dtype)
             mlp = dense(nn.gelu(dense(b, lp["up_kernel"], lp["up_bias"])),
                         lp["down_kernel"], lp["down_bias"])

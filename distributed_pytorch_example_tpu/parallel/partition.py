@@ -44,6 +44,15 @@ TRANSFORMER_TP_RULES: tuple = (
     # stays replicated (tiny, and every token needs it)
     (r"moe/(up|down|gate)_kernel$", P("expert", None, None)),
     (r"moe/(up|down)_bias$", P("expert", None)),
+    # layer-stacked MoE decoder (every-block experts, models/stacked.py):
+    # (L, E, ...) expert weights shard stages on 'pipe' and the expert dim
+    # on 'expert' — PP x EP; routers replicate within their stage. MUST
+    # precede the generic stacked rules: 'moe_up_kernel' would otherwise
+    # match `(q|k|v|up|gate)_kernel$` and mis-shard.
+    (r"moe_(up|down|gate)_kernel$", P("pipe", "expert", None, None)),
+    (r"moe_(up|down)_bias$", P("pipe", "expert", None)),
+    (r"router_kernel$", P("pipe", None, None)),
+    (r"router_bias$", P("pipe", None)),
     # layer-stacked decoder (models/stacked.py): leading num_layers dim on
     # 'pipe' (pipeline stages), features on 'tensor' per the same Megatron
     # column/row split. Ordered after the moe rules: `up_kernel$` would

@@ -83,12 +83,21 @@ def _store(buf, y, slot, cond):
 
 
 def _gpipe_local(stage_params, in_buf, *, stage_fn: StageFn, axis_name: str,
-                 n_micro: int):
+                 n_micro: int, aux_init: Any = None):
     """Per-device pipeline program; call under shard_map (manual on pipe).
 
     stage_params: local slice (1, ...) of the stage-stacked params.
     in_buf: (m, microbatch, ...) — this stage's shard of the microbatch
     queue (stage d initially holds microbatches [d*m, (d+1)*m)).
+
+    ``aux_init``: when given (a pytree of f32 scalar zeros), ``stage_fn``
+    returns ``(h, aux)`` and the schedule accumulates aux ONLY for useful
+    ticks — every device computes at every tick (SPMD), and a bubble
+    tick's garbage routing must not pollute e.g. MoE load-balancing
+    losses. Stage s's tick t processes microbatch t - s, which is real
+    iff 0 <= t - s < n_micro. The per-stage sums are psum'd over the pipe
+    axis, so the returned aux is the total over all (layer, microbatch)
+    contributions.
     """
     n_stages = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -106,7 +115,7 @@ def _gpipe_local(stage_params, in_buf, *, stage_fn: StageFn, axis_name: str,
     n_ticks = gpipe_ticks(n_micro, n_stages)
 
     def tick(carry, t):
-        incoming, in_buf, out_buf, reg_y, reg_u = carry
+        incoming, in_buf, out_buf, reg_y, reg_u, aux_acc = carry
 
         # stage 0 feeds from its queue head; later stages from upstream.
         # The queue is circular (head slot = t % m): the head is ppermuted
@@ -115,7 +124,16 @@ def _gpipe_local(stage_params, in_buf, *, stage_fn: StageFn, axis_name: str,
         head_slot = t % m
         head = lax.dynamic_index_in_dim(in_buf, head_slot, 0, keepdims=False)
         x_in = jnp.where(stage == 0, head, incoming)
-        y = stage_fn(params, x_in)
+        if aux_init is None:
+            y = stage_fn(params, x_in)
+        else:
+            y, aux_tick = stage_fn(params, x_in)
+            u_proc = t - stage
+            useful = (u_proc >= 0) & (u_proc < n_micro)
+            aux_acc = jax.tree_util.tree_map(
+                lambda a, b: a + jnp.where(useful, b, 0.0),
+                aux_acc, aux_tick,
+            )
 
         u_emit = t - (n_stages - 1)  # microbatch the last stage finishes now
         emitting = (u_emit >= 0) & (u_emit < n_micro)
@@ -149,7 +167,7 @@ def _gpipe_local(stage_params, in_buf, *, stage_fn: StageFn, axis_name: str,
         in_buf = lax.dynamic_update_index_in_dim(
             in_buf, received, head_slot, 0
         )
-        return (incoming, in_buf, out_buf, reg_y, reg_u), None
+        return (incoming, in_buf, out_buf, reg_y, reg_u, aux_acc), None
 
     # carries become pipe-varying through the stage params / ppermute, so
     # constant inits must carry that vma too
@@ -160,11 +178,17 @@ def _gpipe_local(stage_params, in_buf, *, stage_fn: StageFn, axis_name: str,
     outputs0 = pv(jnp.zeros_like(in_buf))
     reg_y0 = pv(jnp.zeros(in_buf.shape[1:], in_buf.dtype))
     reg_u0 = pv(jnp.full((), -1, jnp.int32))
-    (_, _, out_buf, _, _), _ = lax.scan(
-        tick, (incoming0, in_buf, outputs0, reg_y0, reg_u0),
+    aux0 = None if aux_init is None else pv(aux_init)
+    (_, _, out_buf, _, _, aux_acc), _ = lax.scan(
+        tick, (incoming0, in_buf, outputs0, reg_y0, reg_u0, aux0),
         jnp.arange(n_ticks),
     )
-    return out_buf
+    if aux_init is None:
+        return out_buf
+    aux_total = jax.tree_util.tree_map(
+        lambda a: lax.psum(a, axis_name), aux_acc
+    )
+    return out_buf, aux_total
 
 
 def gpipe(
@@ -176,12 +200,14 @@ def gpipe(
     *,
     pipe_axis: str = "pipe",
     batch_axes: Sequence[str] = ("data", "fsdp"),
+    aux_init: Any = None,
 ) -> jax.Array:
     """Run ``x`` through ``n_stages`` pipelined stages of ``stage_fn``.
 
     Args:
       stage_fn: ``(stage_param_slice, activation) -> activation`` — shape
-        preserving (homogeneous stages).
+        preserving (homogeneous stages). With ``aux_init`` set it returns
+        ``(activation, aux)`` instead.
       stage_params: pytree whose leaves are stacked on a leading
         ``n_stages`` dim; sharded over ``pipe_axis`` (one stage per device).
         Shardings over other mesh axes (e.g. ``tensor``) stay automatic.
@@ -190,8 +216,14 @@ def gpipe(
         multiple of the pipe-axis size).
       mesh: mesh containing ``pipe_axis`` (and optionally data axes the
         batch dim is sharded over).
+      aux_init: optional pytree of f32 scalar zeros matching the aux
+        structure ``stage_fn`` emits per microbatch (e.g. MoE auxiliary
+        losses). Bubble-tick garbage is excluded; the returned aux is the
+        SUM over every (stage layer, microbatch) contribution — divide by
+        ``n_micro`` for per-batch means.
 
-    Returns activations of the final stage, same shape as ``x``.
+    Returns activations of the final stage, same shape as ``x``; with
+    ``aux_init``, the tuple ``(activations, aux_totals)``.
     """
     batch = x.shape[0]
     n_stages = mesh.shape[pipe_axis]
@@ -213,18 +245,25 @@ def gpipe(
     fn = jax.shard_map(
         functools.partial(
             _gpipe_local, stage_fn=stage_fn, axis_name=pipe_axis,
-            n_micro=n_micro,
+            n_micro=n_micro, aux_init=aux_init,
         ),
         mesh=mesh,
         in_specs=(
             jax.tree_util.tree_map(lambda _: P(pipe_axis), stage_params),
             P(pipe_axis),
         ),
-        out_specs=P(pipe_axis),
+        # aux is psum'd over the pipe axis inside: replicated on the way out
+        out_specs=P(pipe_axis) if aux_init is None else (
+            P(pipe_axis),
+            jax.tree_util.tree_map(lambda _: P(), aux_init),
+        ),
         axis_names={pipe_axis},
     )
-    out = fn(stage_params, x_stack)
-    return out.reshape(x.shape)
+    if aux_init is None:
+        out = fn(stage_params, x_stack)
+        return out.reshape(x.shape)
+    out, aux = fn(stage_params, x_stack)
+    return out.reshape(x.shape), aux
 
 
 def stack_stage_params(per_stage_params: Sequence[Any]) -> Any:

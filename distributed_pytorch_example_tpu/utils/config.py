@@ -78,6 +78,10 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         help=">0: MoE MLP with this many experts on every "
                         "other transformer block (gpt2: gelu experts; "
                         "llama: Mixtral-style SwiGLU experts)")
+    parser.add_argument("--moe-every", type=int, default=2,
+                        help="MoE MLP on every Nth block (2 = Switch "
+                        "cadence; 1 = every block, required for "
+                        "--mesh-pipe + --moe-experts)")
     parser.add_argument("--moe-top-k", type=int, default=None,
                         help="experts per token (1 = Switch, 2 = GShard/"
                         "Mixtral); default: the model's own default "

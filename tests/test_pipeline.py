@@ -188,3 +188,38 @@ def test_schedule_tick_count_matches_formula(devices):
         out = gpipe(stage_fn, params, x, mesh, n_micro)
     np.testing.assert_allclose(np.asarray(out), 1.0 + 4.0)
     assert gpipe_ticks(n_micro, 4) == 11
+
+
+def test_aux_accumulation_excludes_bubble_ticks(devices):
+    """With aux_init, stage_fn aux is summed over (stage, microbatch) and
+    the bubble ticks' garbage contributions are EXCLUDED: an aux of 1.0
+    per call totals exactly n_stages * n_micro, not n_stages * n_ticks."""
+    from distributed_pytorch_example_tpu.parallel.pipeline import (
+        gpipe,
+        gpipe_ticks,
+    )
+
+    mesh = make_mesh(MeshSpec(data=2, pipe=4))
+    n_micro, batch = 8, 16
+    x = jnp.ones((batch, 4), jnp.float32)
+    params = jnp.zeros((4, 1), jnp.float32)
+
+    def stage_fn(p, h):
+        return h + 1.0 + 0.0 * p.sum(), {
+            "count": jnp.float32(1.0),
+            "mean_in": h.mean(),
+        }
+
+    with mesh:
+        out, aux = gpipe(
+            stage_fn, params, x, mesh, n_micro,
+            aux_init={"count": jnp.float32(0), "mean_in": jnp.float32(0)},
+        )
+    np.testing.assert_allclose(np.asarray(out), 5.0)
+    assert float(aux["count"]) == 4 * n_micro  # not 4 * gpipe_ticks(...)
+    assert gpipe_ticks(n_micro, 4) > n_micro
+    # mean_in sums h.mean() over useful (stage, microbatch) pairs: each
+    # microbatch enters stage s with value 1 + s
+    np.testing.assert_allclose(
+        float(aux["mean_in"]), n_micro * (1 + 2 + 3 + 4 - 0), rtol=1e-6
+    )

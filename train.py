@@ -226,8 +226,18 @@ def main():
             parser.error(f"--moe-experts is only supported for gpt2 and "
                          f"llama models, not {args.model!r}")
         overrides["moe_experts"] = args.moe_experts
+        overrides["moe_every"] = args.moe_every
         if args.moe_top_k is not None:  # None: keep the model's default
             overrides["moe_top_k"] = args.moe_top_k
+        if args.mesh_pipe not in (0, 1):
+            if not args.model.startswith("gpt"):
+                parser.error("--mesh-pipe with --moe-experts is gpt2-only "
+                             "(the stacked LLaMA decoder has no MoE "
+                             "variant yet)")
+            if args.moe_every != 1:
+                parser.error("--mesh-pipe with --moe-experts needs "
+                             "homogeneous stages: set --moe-every 1 "
+                             "(experts on every block)")
     if args.moe_top_k is not None and not args.moe_experts:
         parser.error("--moe-top-k without --moe-experts has nothing to "
                      "route; set --moe-experts too")
