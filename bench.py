@@ -42,6 +42,9 @@ BASELINES = {
     "vit-b16": ("samples", 500.0),
     "bert-base": ("tokens", 30_000.0),
     "gpt2": ("tokens", 30_000.0),
+    # beyond-BASELINE zoo entry (RMSNorm/RoPE/GQA/SwiGLU, ~110M); not in
+    # the default sweep — `--model llama` benches it
+    "llama": ("tokens", 30_000.0),
 }
 DEFAULT_MODELS = ("resnet18", "resnet50", "vit-b16", "bert-base", "gpt2")
 
@@ -85,7 +88,7 @@ def run_model(name: str, args) -> dict:
 
     import distributed_pytorch_example_tpu as dpx
 
-    lm = name.startswith(("gpt", "bert"))
+    lm = name.startswith(("gpt", "bert", "llama"))
     batch_per_chip = args.batch_per_chip or (16 if lm else 128)
     if name == "resnet18":
         image_size, num_classes = 32, 10  # BASELINE config 1: CIFAR-10

@@ -87,7 +87,18 @@ def dot_product_attention(
         softmax_scale = 1.0 / math.sqrt(q.shape[-1])
 
     if use_flash is None:
-        use_flash = _flash_unsupported_reason(q, k, v, mask, causal) is None
+        reason = _flash_unsupported_reason(q, k, v, mask, causal)
+        use_flash = reason is None
+        if not use_flash and _only_seq_misaligned(q, k, v, mask, causal):
+            # e.g. ViT's 197 tokens: lane-pad the sequence to the next
+            # multiple of 128 with the pad keys masked out — the XLA
+            # fallback's (B, N, S, S) f32 logits are an HBM-bound hog
+            # (~25% of a ViT-B/16 step) the flash kernel avoids even at a
+            # 30% pad; padded queries compute garbage that is sliced off
+            # (their cotangents are zero, so grads stay exact)
+            return _flash_lane_padded(
+                q, k, v, kv_mask, causal, softmax_scale
+            )
     elif use_flash:
         # forced flash must not silently degrade or crash deep in lowering:
         # surface exactly why the kernel can't serve this call
@@ -105,6 +116,41 @@ def dot_product_attention(
             softmax_scale=softmax_scale,
         )
     return _xla_attention(q, k, v, mask, kv_mask, causal, softmax_scale)
+
+
+def _only_seq_misaligned(q, k, v, mask, causal) -> bool:
+    """True when sequence alignment is the ONLY flash blocker (self-
+    attention with seq % 128 != 0) — the case lane-padding can serve."""
+    seq_q, seq_k = q.shape[1], k.shape[1]
+    if seq_q != seq_k or seq_q % 128 == 0:
+        return False
+    padded = list(q.shape)
+    padded[1] = seq_q + (-seq_q % 128)
+    probe = jax.ShapeDtypeStruct(tuple(padded), q.dtype)
+    kprobe = jax.ShapeDtypeStruct(
+        (k.shape[0], padded[1], *k.shape[2:]), k.dtype
+    )
+    return _flash_unsupported_reason(probe, kprobe, kprobe, mask, causal) is None
+
+
+def _flash_lane_padded(q, k, v, kv_mask, causal, softmax_scale):
+    """Flash on a lane-padded sequence: pad keys masked, pad queries
+    discarded. Exact for the real positions (fully-padded rows emit zero
+    output and zero gradients — see flash_attention's kv_mask contract)."""
+    import jax.numpy as jnp
+
+    from distributed_pytorch_example_tpu.ops.pallas import flash_attention
+
+    seq = q.shape[1]
+    pad = -seq % 128
+    pad_widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+    valid = jnp.ones((q.shape[0], seq), bool) if kv_mask is None else kv_mask
+    mask_p = jnp.pad(valid.astype(bool), ((0, 0), (0, pad)))
+    out = flash_attention.flash_attention(
+        jnp.pad(q, pad_widths), jnp.pad(k, pad_widths), jnp.pad(v, pad_widths),
+        causal=causal, kv_mask=mask_p, softmax_scale=softmax_scale,
+    )
+    return out[:, :seq]
 
 
 @functools.lru_cache(maxsize=1)
