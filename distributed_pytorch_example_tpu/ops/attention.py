@@ -87,23 +87,28 @@ def dot_product_attention(
         softmax_scale = 1.0 / math.sqrt(q.shape[-1])
 
     if use_flash is None:
-        reason = _flash_unsupported_reason(q, k, v, mask, causal)
-        use_flash = reason is None
-        if not use_flash and _only_seq_misaligned(q, k, v, mask, causal):
-            # e.g. ViT's 197 tokens: lane-pad the sequence to the next
-            # multiple of 128 with the pad keys masked out — the XLA
-            # fallback's (B, N, S, S) f32 logits are an HBM-bound hog
-            # (~25% of a ViT-B/16 step) the flash kernel avoids even at a
-            # 30% pad; padded queries compute garbage that is sliced off
-            # (their cotangents are zero, so grads stay exact)
-            return _flash_lane_padded(
-                q, k, v, kv_mask, causal, softmax_scale
-            )
+        # Auto-dispatch picks flash only when the kernel serves the shapes
+        # natively. Misaligned sequences (e.g. ViT's 197 tokens) go to the
+        # XLA path: lane-padding them into the flash kernel was measured
+        # SLOWER at ViT-B/16 bench shapes (batch 128, bf16, 197 tokens:
+        # ~193 ms/step padded-flash vs ~137 ms XLA — the short sequence's
+        # (B, N, S, S) logits are small enough that XLA's fused softmax
+        # beats flash's 30% pad overhead). The padded path stays available
+        # as an explicit use_flash=True opt-in for callers who measured a
+        # win at their shapes.
+        use_flash = _flash_unsupported_reason(q, k, v, mask, causal) is None
     elif use_flash:
-        # forced flash must not silently degrade or crash deep in lowering:
-        # surface exactly why the kernel can't serve this call
         reason = _flash_unsupported_reason(q, k, v, mask, causal)
         if reason is not None:
+            if _only_seq_misaligned(q, k, v, mask, causal):
+                # explicit opt-in: serve seq % 128 != 0 by lane-padding
+                # (pad keys masked out, pad-query outputs sliced off; their
+                # cotangents are zero, so grads stay exact)
+                return _flash_lane_padded(
+                    q, k, v, kv_mask, causal, softmax_scale
+                )
+            # forced flash must not silently degrade or crash deep in
+            # lowering: surface exactly why the kernel can't serve this call
             raise ValueError(
                 f"use_flash=True but the flash kernel does not support this "
                 f"call: {reason}. Use use_flash=None to auto-select."
@@ -133,10 +138,16 @@ def _only_seq_misaligned(q, k, v, mask, causal) -> bool:
     return _flash_unsupported_reason(probe, kprobe, kprobe, mask, causal) is None
 
 
-def _flash_lane_padded(q, k, v, kv_mask, causal, softmax_scale):
+def _flash_lane_padded(q, k, v, kv_mask, causal, softmax_scale,
+                       interpret=False):
     """Flash on a lane-padded sequence: pad keys masked, pad queries
     discarded. Exact for the real positions (fully-padded rows emit zero
-    output and zero gradients — see flash_attention's kv_mask contract)."""
+    output and zero gradients — see flash_attention's kv_mask contract).
+
+    NOT on the auto-dispatch path: measured slower than the XLA fallback at
+    ViT-B/16 bench shapes (see dot_product_attention). Reached only via an
+    explicit ``use_flash=True``; ``interpret=True`` runs it on CPU for
+    numerics tests."""
     import jax.numpy as jnp
 
     from distributed_pytorch_example_tpu.ops.pallas import flash_attention
@@ -149,6 +160,7 @@ def _flash_lane_padded(q, k, v, kv_mask, causal, softmax_scale):
     out = flash_attention.flash_attention(
         jnp.pad(q, pad_widths), jnp.pad(k, pad_widths), jnp.pad(v, pad_widths),
         causal=causal, kv_mask=mask_p, softmax_scale=softmax_scale,
+        interpret=interpret,
     )
     return out[:, :seq]
 

@@ -209,6 +209,97 @@ def test_gqa_forward_and_grads_match_xla(causal):
         )
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("seq", [197, 100])
+def test_lane_padded_forward_matches_xla(causal, seq):
+    """Explicit-opt-in lane-padded flash at seq % 128 != 0 (ViT's 197)."""
+    from distributed_pytorch_example_tpu.ops.attention import _flash_lane_padded
+
+    q, k, v = make_qkv(seq=seq)
+    scale = q.shape[-1] ** -0.5
+    expected = _xla_attention(q, k, v, None, None, causal, scale)
+    got = _flash_lane_padded(q, k, v, None, causal, scale, interpret=True)
+    assert got.shape == q.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_lane_padded_grads_match_xla(causal):
+    """Padded queries' cotangents are zero: grads at 197 tokens are exact."""
+    from distributed_pytorch_example_tpu.ops.attention import _flash_lane_padded
+
+    q, k, v = make_qkv(seq=197, seed=3)
+    scale = q.shape[-1] ** -0.5
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, None, None, causal, scale) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            _flash_lane_padded(q, k, v, None, causal, scale, interpret=True)
+            ** 2
+        )
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ref, g_flash, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+def test_lane_padded_kv_mask_and_fully_padded_row():
+    """kv_mask streams through the pad; a fully-padded batch row emits
+    zero output and zero grads (the flash kv_mask contract survives
+    lane-padding)."""
+    from distributed_pytorch_example_tpu.ops.attention import _flash_lane_padded
+
+    q, k, v = make_qkv(seq=197, seed=13)
+    mask = np.ones((2, 197), bool)
+    mask[0, 150:] = False  # partial padding on row 0
+    mask[1, :] = False     # row 1 fully padded
+    kv_mask = jnp.asarray(mask)
+    scale = q.shape[-1] ** -0.5
+
+    expected = _xla_attention(q, k, v, None, kv_mask, False, scale)
+    got = _flash_lane_padded(q, k, v, kv_mask, False, scale, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(got)[1], 0.0)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            _flash_lane_padded(q, k, v, kv_mask, False, scale, interpret=True)
+            ** 2
+        )
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g, name in zip(grads, "qkv"):
+        g = np.asarray(g)
+        assert np.all(np.isfinite(g)), f"d{name} has non-finite values"
+        np.testing.assert_array_equal(g[1], 0.0, err_msg=f"d{name} row 1")
+
+
+def test_misaligned_seq_auto_dispatch_takes_xla(monkeypatch):
+    """Auto dispatch at seq % 128 != 0 must use the XLA path — the
+    lane-padded flash path measured SLOWER at ViT bench shapes and is
+    opt-in only (BENCH_r03 regression, VERDICT r3 #1)."""
+    from distributed_pytorch_example_tpu.ops import attention
+
+    def _boom(*a, **kw):  # pragma: no cover - fails the test if reached
+        raise AssertionError("auto dispatch took the lane-padded flash path")
+
+    monkeypatch.setattr(attention, "_flash_lane_padded", _boom)
+    # pretend we're on TPU so seq misalignment is the ONLY flash blocker —
+    # otherwise the r3 (regressing) dispatch would also skip the padded
+    # path here (CPU rig) and the guard would pass vacuously
+    monkeypatch.setattr(attention, "_on_tpu", lambda: True)
+    q, k, v = make_qkv(seq=197)
+    scale = q.shape[-1] ** -0.5
+    expected = _xla_attention(q, k, v, None, None, False, scale)
+    got = attention.dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
 def test_gqa_indivisible_heads_not_selected():
     from distributed_pytorch_example_tpu.ops.attention import (
         _flash_unsupported_reason,
