@@ -169,10 +169,20 @@ class Llama(nn.Module):
             )
         if self.decode and self.logits_mode != "full":
             raise ValueError("decode mode requires logits_mode='full'")
-        if self.pipe_axis is not None and (self.seq_axis or self.moe_experts):
+        if self.pipe_axis is not None and self.seq_axis:
             raise ValueError(
-                "pipe_axis cannot combine with seq_axis or moe_experts yet "
-                "(the pipeline stages are homogeneous dense blocks)"
+                "pipe_axis cannot combine with seq_axis yet (the pipeline "
+                "stages are whole-sequence blocks)"
+            )
+        if (
+            self.pipe_axis is not None
+            and self.moe_experts
+            and self.moe_every != 1
+        ):
+            raise ValueError(
+                "pipelined MoE needs homogeneous stages: set moe_every=1 "
+                "(experts on EVERY block) to combine pipe_axis with "
+                "moe_experts"
             )
         if self.moe_experts > 0 and self.moe_every < 1:
             raise ValueError(
@@ -214,6 +224,9 @@ class Llama(nn.Module):
                 remat=self.remat,
                 pipe_axis=self.pipe_axis,
                 pipe_microbatches=self.pipe_microbatches,
+                moe_experts=self.moe_experts,
+                moe_top_k=self.moe_top_k,
+                moe_capacity_factor=self.moe_capacity_factor,
                 name="decoder",
             )(x, train=train)
             return self._head(x)

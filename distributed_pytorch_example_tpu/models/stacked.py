@@ -149,6 +149,39 @@ def _run_stacked(mod, params, x, block, aux_init=None):
     return out, aux_sum, float(n_micro)
 
 
+def _run_moe_stacked(mod, params, x, block):
+    """Shared MoE execution for both stacked decoders: scan or GPipe with
+    aux accumulation, then per-layer-MoEMlpBlock-parity sows (losses SUM
+    over layers, batch means; drop fraction averages over layers; under
+    GPipe the bubble-tick garbage is excluded by the schedule's aux_init)."""
+    aux_zero = {
+        "load_balancing": jnp.zeros((), jnp.float32),
+        "router_z": jnp.zeros((), jnp.float32),
+        "dropped_fraction": jnp.zeros((), jnp.float32),
+    }
+    out, aux_sum, n_batches = _run_stacked(
+        mod, params, x, block, aux_init=aux_zero
+    )
+    lb = aux_sum["load_balancing"] / n_batches
+    rz = aux_sum["router_z"] / n_batches
+    mod.sow(
+        "losses", "load_balancing", mod.moe_aux_loss_weight * lb,
+        reduce_fn=lambda a, b: a + b,
+        init_fn=lambda: jnp.zeros((), jnp.float32),
+    )
+    mod.sow(
+        "losses", "router_z", mod.moe_z_loss_weight * rz,
+        reduce_fn=lambda a, b: a + b,
+        init_fn=lambda: jnp.zeros((), jnp.float32),
+    )
+    if not mod.is_initializing():
+        mod.sow(
+            "moe_metrics", "dropped_fraction",
+            aux_sum["dropped_fraction"] / (n_batches * mod.num_layers),
+        )
+    return out
+
+
 class StackedDecoder(nn.Module):
     """Homogeneous pre-LN transformer blocks with layer-stacked params.
 
@@ -183,8 +216,12 @@ class StackedDecoder(nn.Module):
         L, D, M = self.num_layers, self.model_dim, self.mlp_dim
         F = self.num_heads * self.head_dim
         E = self.moe_experts
-        lecun = nn.initializers.lecun_normal()
-        lecun_e = nn.initializers.lecun_normal(batch_axis=(0,))
+        # init parity with the per-layer blocks: the leading layer dim (and
+        # the expert dim for MoE kernels) must be batch axes, or
+        # variance_scaling counts them into fan_in and init std shrinks by
+        # sqrt(L) (sqrt(L*E) for experts) vs the unstacked reference
+        lecun = nn.initializers.lecun_normal(batch_axis=(0,))
+        lecun_e = nn.initializers.lecun_normal(batch_axis=(0, 1))
         zeros, ones = nn.initializers.zeros, nn.initializers.ones
 
         def stacked(name, init, shape):
@@ -226,34 +263,7 @@ class StackedDecoder(nn.Module):
 
     def _run_moe(self, params, x):
         """MoE stack: scan or GPipe, aux losses gated past bubble ticks."""
-        aux_zero = {
-            "load_balancing": jnp.zeros((), jnp.float32),
-            "router_z": jnp.zeros((), jnp.float32),
-            "dropped_fraction": jnp.zeros((), jnp.float32),
-        }
-        out, aux_sum, n_batches = _run_stacked(
-            self, params, x, self._moe_block_fn(x.shape), aux_init=aux_zero
-        )
-        # aux semantics parity with the per-layer MoEMlpBlock: losses SUM
-        # over layers, batch means; drop fraction averages over layers
-        lb = aux_sum["load_balancing"] / n_batches
-        rz = aux_sum["router_z"] / n_batches
-        self.sow(
-            "losses", "load_balancing", self.moe_aux_loss_weight * lb,
-            reduce_fn=lambda a, b: a + b,
-            init_fn=lambda: jnp.zeros((), jnp.float32),
-        )
-        self.sow(
-            "losses", "router_z", self.moe_z_loss_weight * rz,
-            reduce_fn=lambda a, b: a + b,
-            init_fn=lambda: jnp.zeros((), jnp.float32),
-        )
-        if not self.is_initializing():
-            self.sow(
-                "moe_metrics", "dropped_fraction",
-                aux_sum["dropped_fraction"] / (n_batches * self.num_layers),
-            )
-        return out
+        return _run_moe_stacked(self, params, x, self._moe_block_fn(x.shape))
 
     def _moe_block_fn(self, x_shape):
         """(layer_params, h) -> (h, aux); attention + gelu-expert MoE."""
@@ -357,6 +367,11 @@ class StackedLlamaDecoder(nn.Module):
     remat: bool = False
     pipe_axis: Optional[str] = None
     pipe_microbatches: int = 0
+    moe_experts: int = 0  # >0: Mixtral-style SwiGLU-expert MoE, EVERY block
+    moe_top_k: int = 2  # Mixtral default
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 0.01
+    moe_z_loss_weight: float = 1e-3
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -368,7 +383,10 @@ class StackedLlamaDecoder(nn.Module):
         L, D, M = self.num_layers, self.model_dim, self.mlp_dim
         F = self.num_heads * self.head_dim
         KF = self.num_kv_heads * self.head_dim
-        lecun = nn.initializers.lecun_normal()
+        E = self.moe_experts
+        # leading layer/expert dims as batch axes — see StackedDecoder
+        lecun = nn.initializers.lecun_normal(batch_axis=(0,))
+        lecun_e = nn.initializers.lecun_normal(batch_axis=(0, 1))
         ones = nn.initializers.ones
 
         def stacked(name, init, shape):
@@ -381,14 +399,38 @@ class StackedLlamaDecoder(nn.Module):
             "v_kernel": stacked("v_kernel", lecun, (D, KF)),
             "o_kernel": stacked("o_kernel", lecun, (F, D)),
             "ln2_scale": stacked("ln2_scale", ones, (D,)),
+        }
+        if E:
+            # Mixtral-style PP x EP: SwiGLU experts (bias-free, like the
+            # dense SwiGLU each replaces) with (L, E, ...) weights — 'pipe'
+            # shards stages, 'expert' shards the expert dim (the
+            # moe_(gate|up|down)_kernel partition rules). Router keeps the
+            # per-layer MoEMlpBlock's Dense-with-bias convention.
+            params.update({
+                "router_kernel": stacked("router_kernel", lecun, (D, E)),
+                "router_bias": stacked(
+                    "router_bias", nn.initializers.zeros, (E,)
+                ),
+                "moe_gate_kernel": stacked(
+                    "moe_gate_kernel", lecun_e, (E, D, M)
+                ),
+                "moe_up_kernel": stacked("moe_up_kernel", lecun_e, (E, D, M)),
+                "moe_down_kernel": stacked(
+                    "moe_down_kernel", lecun_e, (E, M, D)
+                ),
+            })
+            return _run_moe_stacked(
+                self, params, x, self._moe_block_fn(x.shape)
+            )
+        params.update({
             "gate_kernel": stacked("gate_kernel", lecun, (D, M)),
             "up_kernel": stacked("up_kernel", lecun, (D, M)),
             "down_kernel": stacked("down_kernel", lecun, (M, D)),
-        }
+        })
         return _run_stacked(self, params, x, self._block_fn(x.shape))
 
-    def _block_fn(self, x_shape):
-        """(layer_params, h) -> h; pre-RMSNorm GQA block, compute dtype."""
+    def _attn_fn(self, x_shape):
+        """(layer_params, h) -> h after the RoPE/GQA attention residual."""
         from distributed_pytorch_example_tpu.ops.rope import rope
 
         seq = x_shape[1]
@@ -402,7 +444,7 @@ class StackedLlamaDecoder(nn.Module):
         def dense(z, kernel):
             return z @ kernel.astype(dtype)
 
-        def block(lp, h):
+        def attn_part(lp, h):
             a = _rms_norm(h, lp["ln1_scale"], eps, dtype)
             q = dense(a, lp["q_kernel"]).reshape(q_shape)
             k = dense(a, lp["k_kernel"]).reshape(kv_shape)
@@ -413,13 +455,58 @@ class StackedLlamaDecoder(nn.Module):
                 q, k, v, causal=True, softmax_scale=scale,
                 use_flash=self.use_flash,
             )
-            h = h + dense(attn.reshape(*h.shape[:-1], -1), lp["o_kernel"])
+            return h + dense(attn.reshape(*h.shape[:-1], -1), lp["o_kernel"])
+
+        return attn_part
+
+    def _block_fn(self, x_shape):
+        """(layer_params, h) -> h; pre-RMSNorm GQA block, compute dtype."""
+        attn = self._attn_fn(x_shape)
+        dtype = self.dtype
+        eps = self.layer_norm_epsilon
+
+        def dense(z, kernel):
+            return z @ kernel.astype(dtype)
+
+        def block(lp, h):
+            h = attn(lp, h)
             b = _rms_norm(h, lp["ln2_scale"], eps, dtype)
             mlp = dense(
                 nn.silu(dense(b, lp["gate_kernel"])) * dense(b, lp["up_kernel"]),
                 lp["down_kernel"],
             )
             return h + mlp
+
+        return block
+
+    def _moe_block_fn(self, x_shape):
+        """(layer_params, h) -> (h, aux); attention + SwiGLU-expert MoE."""
+        from distributed_pytorch_example_tpu.models.moe import moe_apply
+
+        attn = self._attn_fn(x_shape)
+        dtype = self.dtype
+        eps = self.layer_norm_epsilon
+        top_k = self.moe_top_k
+        cf = self.moe_capacity_factor
+
+        def block(lp, h):
+            h = attn(lp, h)
+            b = _rms_norm(h, lp["ln2_scale"], eps, dtype)
+            router_logits = (
+                b.astype(jnp.float32)
+                @ lp["router_kernel"].astype(jnp.float32)
+                + lp["router_bias"].astype(jnp.float32)
+            )
+            y, aux = moe_apply(
+                b, router_logits,
+                {
+                    "gate_kernel": lp["moe_gate_kernel"],
+                    "up_kernel": lp["moe_up_kernel"],
+                    "down_kernel": lp["moe_down_kernel"],
+                },
+                top_k=top_k, capacity_factor=cf, dtype=dtype, swiglu=True,
+            )
+            return h + y, aux
 
         return block
 

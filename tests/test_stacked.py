@@ -31,6 +31,32 @@ def test_param_shapes_are_layer_stacked(devices):
     assert params["ln1_scale"].shape == (4, 16)
 
 
+def test_stacked_init_std_matches_per_layer(devices):
+    """Stacked kernels must init like the per-layer blocks they mirror:
+    leading layer (and expert) dims are batch axes, NOT fan-in — otherwise
+    init std shrinks by sqrt(L) (sqrt(L*E) for experts) and a pipelined
+    model trained from init differs from the sequential reference."""
+    from distributed_pytorch_example_tpu.models.stacked import (
+        StackedLlamaDecoder,
+    )
+
+    model = StackedDecoder(**CFG, moe_experts=4, moe_top_k=2)
+    params, _ = _init_and_input(model)
+    expect = 1.0 / np.sqrt(16)  # lecun: sqrt(1/fan_in), fan_in = model_dim
+    got = float(np.std(np.asarray(params["q_kernel"])))
+    np.testing.assert_allclose(got, expect, rtol=0.2)
+    got_e = float(np.std(np.asarray(params["moe_up_kernel"])))
+    np.testing.assert_allclose(got_e, expect, rtol=0.2)
+
+    lmodel = StackedLlamaDecoder(**LLAMA_MOE_CFG)
+    lp = lmodel.init(
+        jax.random.key(0), jnp.zeros((2, 8, 16), jnp.float32)
+    )["params"]
+    np.testing.assert_allclose(
+        float(np.std(np.asarray(lp["moe_gate_kernel"]))), expect, rtol=0.2
+    )
+
+
 def test_pipelined_matches_sequential(devices):
     seq_model = StackedDecoder(**CFG)
     pipe_model = StackedDecoder(**CFG, pipe_axis="pipe")
@@ -505,6 +531,193 @@ def test_moe_pipelined_matches_sequential(devices):
         ),
         g_pipe, g_seq,
     )
+
+
+LLAMA_MOE_CFG = dict(
+    num_layers=4, num_heads=4, num_kv_heads=2, head_dim=8, model_dim=16,
+    mlp_dim=32, moe_experts=4, moe_top_k=2, moe_capacity_factor=8.0,
+)
+
+
+def test_llama_moe_stacked_matches_per_layer_blocks(devices):
+    """Stacked SwiGLU-expert math == LlamaBlock(moe_experts) with copied
+    weights — outputs AND aux losses."""
+    from distributed_pytorch_example_tpu.models.llama import LlamaBlock
+    from distributed_pytorch_example_tpu.models.stacked import (
+        StackedLlamaDecoder,
+    )
+
+    x = jnp.asarray(
+        np.random.default_rng(8).standard_normal((2, 8, 16)), jnp.float32
+    )
+    blocks, ref_params = [], []
+    for i in range(2):
+        block = LlamaBlock(
+            num_heads=4, num_kv_heads=2, head_dim=4, model_dim=16,
+            mlp_dim=32, moe_experts=4, moe_top_k=2, moe_capacity_factor=8.0,
+        )
+        p = block.init(jax.random.key(10 + i), x)["params"]
+        blocks.append(block)
+        ref_params.append(p)
+
+    stacked_params = {}
+    for new, path in {
+        "q_kernel": ("attn", "q", "kernel"), "k_kernel": ("attn", "k", "kernel"),
+        "v_kernel": ("attn", "v", "kernel"), "o_kernel": ("attn", "o", "kernel"),
+        "ln1_scale": ("ln1", "scale"), "ln2_scale": ("ln2", "scale"),
+        "router_kernel": ("moe", "router", "kernel"),
+        "router_bias": ("moe", "router", "bias"),
+        "moe_gate_kernel": ("moe", "gate_kernel"),
+        "moe_up_kernel": ("moe", "up_kernel"),
+        "moe_down_kernel": ("moe", "down_kernel"),
+    }.items():
+        leaves = []
+        for p in ref_params:
+            node = p
+            for part in path:
+                node = node[part]
+            leaves.append(node)
+        stacked_params[new] = jnp.stack(leaves)
+
+    model = StackedLlamaDecoder(
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=4, model_dim=16,
+        mlp_dim=32, moe_experts=4, moe_top_k=2, moe_capacity_factor=8.0,
+    )
+    got, got_losses, _ = _moe_apply_collect(model, stacked_params, x)
+
+    expected, exp_losses = x, 0.0
+    for block, p in zip(blocks, ref_params):
+        expected, state = block.apply(
+            {"params": p}, expected, mutable=["losses", "moe_metrics"]
+        )
+        exp_losses = exp_losses + sum(
+            jax.tree_util.tree_leaves(state["losses"])
+        )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), atol=1e-5
+    )
+    np.testing.assert_allclose(float(got_losses), float(exp_losses), rtol=1e-5)
+
+
+def test_llama_moe_pipelined_matches_sequential(devices):
+    """PP x EP for the LLaMA family: pipelined SwiGLU-expert stack == the
+    same stacked params run sequentially per microbatch — outputs, aux
+    losses, metric, and gradients (microbatched reference for the routing
+    statistics, as in the GPT-2 twin above)."""
+    n_micro = 4
+    from distributed_pytorch_example_tpu.models.stacked import (
+        StackedLlamaDecoder,
+    )
+
+    seq_model = StackedLlamaDecoder(**LLAMA_MOE_CFG)
+    pipe_model = StackedLlamaDecoder(
+        **LLAMA_MOE_CFG, pipe_axis="pipe", pipe_microbatches=n_micro
+    )
+    x = jnp.asarray(
+        np.random.default_rng(9).standard_normal((8, 8, 16)), jnp.float32
+    )
+    params = seq_model.init(jax.random.key(0), x)["params"]
+    mesh = make_mesh(MeshSpec(data=2, pipe=2, expert=2))
+
+    def seq_micro(p, xs):
+        outs, tot_losses, tot_metric = [], 0.0, 0.0
+        for i in range(n_micro):
+            xm = xs[i * 2 : (i + 1) * 2]
+            out, losses, metric = _moe_apply_collect(seq_model, p, xm)
+            outs.append(out)
+            tot_losses = tot_losses + losses
+            tot_metric = tot_metric + metric
+        return (
+            jnp.concatenate(outs), tot_losses / n_micro,
+            tot_metric / n_micro,
+        )
+
+    exp_out, exp_losses, exp_metric = seq_micro(params, x)
+    with mesh:
+        got_out, got_losses, got_metric = jax.jit(
+            lambda p, x: _moe_apply_collect(pipe_model, p, x)
+        )(params, x)
+    np.testing.assert_allclose(
+        np.asarray(got_out), np.asarray(exp_out), atol=2e-5
+    )
+    np.testing.assert_allclose(float(got_losses), float(exp_losses), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(got_metric), float(exp_metric), rtol=1e-5, atol=1e-7
+    )
+
+    def loss_seq(p):
+        out, losses, _ = seq_micro(p, x)
+        return jnp.mean(out ** 2) + losses
+
+    def loss_pipe(p):
+        out, losses, _ = _moe_apply_collect(pipe_model, p, x)
+        return jnp.mean(out ** 2) + losses
+
+    g_seq = jax.grad(loss_seq)(params)
+    with mesh:
+        g_pipe = jax.jit(jax.grad(loss_pipe))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5
+        ),
+        g_pipe, g_seq,
+    )
+
+
+def test_llama_moe_pipelined_through_trainer(devices):
+    """PP x EP x DP for the modern-LM family: pipelined SwiGLU-expert
+    LLaMA trains end-to-end, expert weights sharded P('pipe','expert')."""
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+    from distributed_pytorch_example_tpu.data.synthetic import (
+        SyntheticTokenDataset,
+    )
+    from distributed_pytorch_example_tpu.models.llama import Llama
+    from distributed_pytorch_example_tpu.parallel.partition import (
+        transformer_partitioner,
+    )
+    from distributed_pytorch_example_tpu.train.loop import Trainer
+    from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
+
+    mesh = make_mesh(MeshSpec(data=2, pipe=2, expert=2))
+    model = Llama(
+        vocab_size=64, max_len=32, model_dim=16, num_layers=4, num_heads=4,
+        num_kv_heads=2, mlp_dim=32, pipe_axis="pipe", moe_experts=4,
+        moe_every=1, moe_top_k=2,
+    )
+    dataset = SyntheticTokenDataset(num_samples=32, seq_len=16, vocab_size=64)
+    loader = DeviceLoader(dataset, 8, mesh=mesh, num_shards=1, shard_id=0)
+    trainer = Trainer(
+        model, CausalLMTask(), optax.adam(1e-2),
+        partitioner=transformer_partitioner(mesh),
+    )
+    with mesh:
+        trainer.init(next(iter(loader))["tokens"])
+        spec = (
+            trainer.state.params["decoder"]["moe_gate_kernel"].sharding.spec
+        )
+        assert spec[0] == "pipe" and spec[1] == "expert"
+        losses = []
+        state = trainer.state
+        for _ in range(4):
+            batch = next(iter(loader))
+            state, metrics = trainer.train_step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert "moe_dropped_fraction" in metrics
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses
+
+
+def test_llama_pipe_moe_needs_every_block(devices):
+    """moe_every != 1 cannot pipeline (heterogeneous stages) — loud error."""
+    from distributed_pytorch_example_tpu.models.llama import Llama
+
+    model = Llama(
+        vocab_size=64, max_len=32, model_dim=16, num_layers=4, num_heads=4,
+        num_kv_heads=2, mlp_dim=32, pipe_axis="pipe", moe_experts=4,
+        moe_every=2,
+    )
+    with pytest.raises(ValueError, match="moe_every=1"):
+        model.init(jax.random.key(0), jnp.zeros((2, 8), jnp.int32))
 
 
 def test_gpt2_moe_pipelined_through_trainer(devices):

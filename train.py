@@ -229,15 +229,12 @@ def main():
         overrides["moe_every"] = args.moe_every
         if args.moe_top_k is not None:  # None: keep the model's default
             overrides["moe_top_k"] = args.moe_top_k
-        if args.mesh_pipe not in (0, 1):
-            if not args.model.startswith("gpt"):
-                parser.error("--mesh-pipe with --moe-experts is gpt2-only "
-                             "(the stacked LLaMA decoder has no MoE "
-                             "variant yet)")
-            if args.moe_every != 1:
-                parser.error("--mesh-pipe with --moe-experts needs "
-                             "homogeneous stages: set --moe-every 1 "
-                             "(experts on every block)")
+        if args.mesh_pipe not in (0, 1) and args.moe_every != 1:
+            # PP x EP serves gpt2 AND llama (SwiGLU experts in the stacked
+            # LLaMA decoder), but stages must be homogeneous
+            parser.error("--mesh-pipe with --moe-experts needs "
+                         "homogeneous stages: set --moe-every 1 "
+                         "(experts on every block)")
     if args.moe_top_k is not None and not args.moe_experts:
         parser.error("--moe-top-k without --moe-experts has nothing to "
                      "route; set --moe-experts too")
