@@ -216,9 +216,15 @@ def random_resized_crop_flip(
     ratio: tuple = (3 / 4, 4 / 3),
     flip: bool = True,
     seed: int = 0,
+    n_threads: int = 1,
 ) -> BatchTransform:
     """ImageNet-standard augmentation: crop a random area/aspect region,
-    resize (bilinear) to ``size`` x ``size``, mirror with p=0.5."""
+    resize (bilinear) to ``size`` x ``size``, mirror with p=0.5.
+
+    ``n_threads`` forwards to the C++ kernel's per-batch-chunk thread pool.
+    Keep the default 1 when the transform runs under ``AugmentedDataset``
+    workers (the usual setup) — two nested pools oversubscribe; raise it
+    only for direct single-worker calls on multi-core hosts."""
     import threading
 
     shared_rng = np.random.default_rng(seed)
@@ -259,7 +265,8 @@ def random_resized_crop_flip(
             # C++ hot loop — bit-identical to the NumPy path below
             # (pinned in tests/test_native.py), without its temporaries
             return {**batch, "x": native(
-                x, np.asarray(crops, np.int64), mirrored, size
+                x, np.asarray(crops, np.int64), mirrored, size,
+                n_threads=n_threads,
             )}
         out = np.empty((b, size, size, c), x.dtype)
         for i, (oy, ox, ch, cw) in enumerate(crops):

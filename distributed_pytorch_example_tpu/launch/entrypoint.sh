@@ -24,11 +24,12 @@
 #                   training continues from the last epoch [default 0].
 #                   The checkpoint dir comes from --checkpoint-dir inside
 #                   SCRIPT_ARGS when present, else $CHECKPOINT_DIR.
-#                   Scope: per-host crash recovery — exits caused by
-#                   signals (rc > 128, e.g. pod teardown SIGTERM) are NOT
-#                   restarted, and a multi-host job only recovers if every
-#                   host exits (peers blocked in a collective must be
-#                   restarted by the orchestrator).
+#                   Scope: per-host crash recovery — crash signals
+#                   (OOM-kill 137, SIGSEGV 139, ...) ARE restarted;
+#                   orchestrator teardown signals (HUP/INT/TERM, rc
+#                   129/130/143) are not, and a multi-host job only
+#                   recovers if every host exits (peers blocked in a
+#                   collective must be restarted by the orchestrator).
 #   CHECKPOINT_DIR  fallback checkpoint dir               [default ./checkpoints]
 #
 # Derived (reference entrypoint.sh:24-28 parity):
@@ -129,9 +130,14 @@ while true; do
   if [ "${rc}" -eq 0 ]; then
     exit 0
   fi
-  if [ "${rc}" -gt 128 ] || [ "${terminating}" -ne 0 ]; then
-    # killed by a signal / teardown in progress: do not fight it
-    echo "INFO: training terminated by signal (rc=${rc}); not restarting" >&2
+  # Only ORCHESTRATOR teardown signals are exempt from restart — HUP (129),
+  # INT (130), TERM (143) mean the platform wants us gone. Crash-by-signal
+  # cases (OOM-kill 137, SIGSEGV 139, ...) are exactly what MAX_RESTARTS
+  # exists to recover, so they fall through to the restart path.
+  if [ "${rc}" -eq 129 ] || [ "${rc}" -eq 130 ] || [ "${rc}" -eq 143 ] \
+      || [ "${terminating}" -ne 0 ]; then
+    echo "INFO: training terminated by orchestrator signal (rc=${rc});" \
+         "not restarting" >&2
     exit "${rc}"
   fi
   attempt=$((attempt + 1))

@@ -10,8 +10,14 @@ Parity contract (reference train.py:178-209, 252-308; SURVEY.md §3.4):
 - host 0 writes, every host reads (train.py:253,256);
 - writes are atomic (tmp + rename) so a killed job never leaves a torn
   ``latest`` checkpoint;
-- resume restarts at the saved epoch (train.py:209,257): step-level state is
-  in ``state.step``, epoch granularity is the loop contract.
+- resume continues AFTER the last finished epoch: the loop stamps each
+  checkpoint with ``epoch + 1`` (train/loop.py, epoch-end save), so a run
+  killed after epoch 2 resumes at epoch 3. This is a deliberate deviation
+  from the reference, which stamps the epoch it just finished and then
+  RE-RUNS it on resume (reference train.py:185,209,257 — the saved epoch is
+  both "work done" and "start point", double-training one epoch). Step-level
+  state is in ``state.step``; epoch granularity is the loop contract. Pinned
+  by tests/test_train.py::test_resume_continues_after_finished_epoch.
 
 Two on-disk formats, both flax-msgpack (no torch, no pickle — portable and
 introspectable), auto-detected on load:

@@ -34,9 +34,20 @@ def dequantize_inputs(x: jax.Array) -> jax.Array:
     FRAMEWORK CONTRACT: a uint8 model input IS a [0,255] image. This is
     applied uniformly — tree-mapped over model inputs in ``_apply_model``
     (every task, train and eval) and in ``train.step.init_state`` — so
-    init and step always trace the model with identical dtypes.
+    init and step always trace the model with identical dtypes. The
+    contract is ENFORCED, not assumed: images are rank >= 3 ((..., H, W, C)
+    batches); a uint8 input of lower rank (e.g. byte-valued token ids,
+    (B, S)) would be silently corrupted by the rescale, so it raises at
+    trace time instead — ship such inputs as int32.
     """
     if x.dtype == jnp.uint8:
+        if x.ndim < 3:
+            raise TypeError(
+                f"uint8 model input of shape {x.shape} is not an image "
+                f"batch (rank < 3); the framework rescales uint8 inputs "
+                f"to [0,1] float32 as images. Cast non-image inputs "
+                f"(e.g. token ids) to int32 on the host."
+            )
         return x.astype(jnp.float32) / 255.0
     return x
 

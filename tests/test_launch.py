@@ -193,8 +193,8 @@ def test_restart_resume_dir_follows_script_args(tmp_path):
 
 
 def test_restart_loop_does_not_fight_signals(tmp_path):
-    """A child killed by a signal (rc > 128) must NOT be restarted — the
-    orchestrator is tearing the pod down."""
+    """A child killed by an ORCHESTRATOR signal (TERM/INT/HUP) must NOT be
+    restarted — the platform is tearing the pod down."""
     stub = tmp_path / "stub.py"
     stub.write_text(
         "import os, signal\n"
@@ -212,6 +212,36 @@ def test_restart_loop_does_not_fight_signals(tmp_path):
     assert proc.returncode > 128
     assert "not restarting" in proc.stderr
     assert "WARN: training exited" not in proc.stderr
+
+
+def test_restart_loop_recovers_crash_signals(tmp_path):
+    """Crash-by-signal (OOM-kill 137, SIGSEGV 139) IS restarted — these are
+    exactly the failures MAX_RESTARTS exists to recover; only orchestrator
+    teardown signals (HUP/INT/TERM) are exempt."""
+    stub = tmp_path / "stub.py"
+    marker = tmp_path / "attempts"
+    stub.write_text(
+        "import os, pathlib, signal, sys\n"
+        f"m = pathlib.Path({str(marker)!r})\n"
+        "n = int(m.read_text()) if m.exists() else 0\n"
+        "m.write_text(str(n + 1))\n"
+        "if n == 0:\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n"  # rc 137, like OOM
+        "sys.exit(0)\n"
+    )
+    env = {
+        "PATH": os.environ["PATH"],
+        "TRAINING_SCRIPT": str(stub),
+        "MAX_RESTARTS": "2",
+        "CHECKPOINT_DIR": "/ck",
+    }
+    proc = subprocess.run(
+        ["bash", ENTRYPOINT], env=env, capture_output=True, text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert marker.read_text() == "2"
+    assert "restart 1/2" in proc.stderr
 
 
 def test_restart_resume_dir_equals_form(tmp_path):

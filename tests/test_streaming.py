@@ -198,13 +198,14 @@ def test_raw_uint8_matches_float_host_scaling(tmp_path, devices):
             normalize=(np.zeros(3, np.float32), np.ones(3, np.float32)),
         )
 
-    # same loss through the jitted step either way (init incl.)
+    # same loss through the jitted step either way (init incl.); the batch
+    # stays rank-4 (B, H, W, C) — the uint8-IS-an-image contract is
+    # rank-gated, and SimpleNet flattens internally
     mesh = make_mesh(MeshSpec(data=8))
     model = dpx.models.get_model("mlp")
     losses = {}
     for name, ds in (("u8", ds_u8), ("f32", ds_f32)):
         b = ds.get_batch(idx)
-        b = {"x": b["x"].reshape(32, -1)[:, :784], "y": b["y"]}
         trainer = dpx.train.Trainer(
             model, ClassificationTask(), optax.adam(1e-3),
             partitioner=dpx.parallel.data_parallel(mesh),
@@ -219,3 +220,18 @@ def test_raw_uint8_matches_float_host_scaling(tmp_path, devices):
             _, metrics = trainer.train_step(trainer.state, batch)
             losses[name] = float(metrics["loss"])
     np.testing.assert_allclose(losses["u8"], losses["f32"], rtol=1e-5)
+
+
+def test_dequantize_rejects_non_image_uint8(devices):
+    """The uint8-IS-an-image contract fails LOUDLY: a rank-2 uint8 input
+    (e.g. byte-valued token ids) must raise, not be silently rescaled."""
+    from distributed_pytorch_example_tpu.train.tasks import dequantize_inputs
+
+    with pytest.raises(TypeError, match="uint8"):
+        dequantize_inputs(jnp.zeros((4, 16), jnp.uint8))
+    # rank-3+ uint8 is an image batch: rescaled
+    out = dequantize_inputs(jnp.full((2, 4, 4, 3), 255, jnp.uint8))
+    assert out.dtype == jnp.float32 and float(out.max()) == 1.0
+    # non-uint8 passes through untouched
+    tok = jnp.zeros((4, 16), jnp.int32)
+    assert dequantize_inputs(tok) is tok

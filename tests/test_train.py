@@ -176,6 +176,29 @@ def test_fit_checkpoints_and_resume(tmp_path, mesh_1d):
     assert int(t2.state.step) == 4 * len(loader)
 
 
+def test_resume_continues_after_finished_epoch(tmp_path, mesh_1d):
+    """Resume semantics, pinned: the checkpoint saved at the end of epoch N
+    is stamped N+1 and a resumed fit's FIRST epoch index is N+1 — the
+    finished epoch is never re-run. Deliberate deviation from the
+    reference, which stamps the finished epoch itself and re-trains it on
+    resume (reference train.py:185,209,257); see train/checkpoint.py
+    module docstring."""
+    ds = learnable_dataset()
+    ckdir = str(tmp_path / "ck")
+    loader = dpx.data.DeviceLoader(ds, 64, mesh=mesh_1d, seed=0)
+    val = dpx.data.DeviceLoader(ds, 64, mesh=mesh_1d, shuffle=False)
+    t1 = make_trainer(mesh_1d, ckpt=ckdir)
+    t1.fit(loader, val, epochs=3)  # runs epochs 0..2
+
+    latest = os.path.join(ckdir, "latest_model.ckpt")
+    _, saved_epoch, _ = load_checkpoint(latest, t1.state)
+    assert saved_epoch == 3  # finished epoch 2, stamped 3 = next to run
+
+    t2 = make_trainer(mesh_1d, ckpt=ckdir)
+    h2 = t2.fit(loader, val, epochs=5, resume=latest)
+    assert [r["epoch"] for r in h2] == [3, 4]  # continues AFTER, no re-run
+
+
 def test_best_checkpoint_tracks_accuracy(tmp_path, mesh_1d):
     """best_model is only rewritten on val-accuracy improvement
     (train.py:292-300)."""
