@@ -58,9 +58,10 @@ def _pos_mask(idx, src, s_loc):
 def _expand_gqa(q, k, v):
     """Repeat kv heads up to q heads for the chunk einsums (GQA).
 
-    Chunk-local and transient — O(S_chunk) extra memory per fold, unlike
-    Ulysses' whole-sequence replication. q-head n reads kv-head
-    n // group, matching the flash kernel's BlockSpec routing.
+    Chunk-local and transient — O(S_chunk) extra memory per fold (the
+    Ulysses side keeps per-device KV flat too, via its grouped exchange,
+    ops/ulysses.py). q-head n reads kv-head n // group, matching the
+    flash kernel's BlockSpec routing.
     """
     group = q.shape[2] // k.shape[2]
     if group == 1:

@@ -68,6 +68,132 @@ def test_gqa_under_ulysses(devices):
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_gqa_grouped_matches_dense(devices, causal):
+    """kv_heads < axis size takes the GROUPED path (no replication):
+    kv=2 over a 4-device sequence axis (rep=2) must match dense GQA."""
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    q, _, _ = make_qkv(heads=8)
+    _, k, v = make_qkv(heads=2, seed=1)
+    scale = q.shape[-1] ** -0.5
+    expected = _xla_attention(q, k, v, None, None, causal, scale)
+    got = ulysses_attention_sharded(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gqa_grouped_grads_match_dense(devices, causal):
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    q, _, _ = make_qkv(heads=8, seq=128)
+    _, k, v = make_qkv(heads=2, seq=128, seed=3)
+    scale = q.shape[-1] ** -0.5
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, None, None, causal, scale) ** 2)
+
+    def loss_uly(q, k, v):
+        return jnp.sum(
+            ulysses_attention_sharded(q, k, v, mesh, causal=causal) ** 2
+        )
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_uly = jax.grad(loss_uly, argnums=(0, 1, 2))(q, k, v)
+    for gr, gg, name in zip(g_ref, g_uly, "qkv"):
+        assert gg.shape == gr.shape
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gr), atol=1e-4, err_msg=f"d{name}"
+        )
+
+
+def test_gqa_grouped_kv_mask_and_dead_rows(devices):
+    """Key-padding masks stream through the grouped path; a fully-padded
+    batch row emits zeros (the _xla_attention contract)."""
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    q, _, _ = make_qkv(heads=8)
+    _, k, v = make_qkv(heads=2, seed=5)
+    mask = np.ones((2, 256), bool)
+    mask[0, 100:] = False
+    mask[1, :] = False  # fully padded row
+    kv_mask = jnp.asarray(mask)
+    scale = q.shape[-1] ** -0.5
+    expected = _xla_attention(q, k, v, None, kv_mask, False, scale)
+    got = ulysses_attention_sharded(q, k, v, mesh, kv_mask=kv_mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(got)[1], 0.0)
+
+
+def test_gqa_grouped_bf16_forward_and_grads(devices):
+    """The custom-VJP grouped path in the training dtype (bfloat16)."""
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    q, _, _ = make_qkv(heads=8, seq=128)
+    _, k, v = make_qkv(heads=2, seq=128, seed=7)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    scale = q.shape[-1] ** -0.5
+
+    expected = _xla_attention(qb, kb, vb, None, None, True, scale)
+    got = ulysses_attention_sharded(qb, kb, vb, mesh, causal=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(expected, np.float32),
+        atol=2e-2,
+    )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            _xla_attention(q, k, v, None, None, True, scale)
+            .astype(jnp.float32) ** 2
+        )
+
+    def loss_uly(q, k, v):
+        return jnp.sum(
+            ulysses_attention_sharded(q, k, v, mesh, causal=True)
+            .astype(jnp.float32) ** 2
+        )
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(qb, kb, vb)
+    g_uly = jax.grad(loss_uly, argnums=(0, 1, 2))(qb, kb, vb)
+    for gr, gg, name in zip(g_ref, g_uly, "qkv"):
+        assert gg.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(gg, np.float32), np.asarray(gr, np.float32),
+            atol=0.15, rtol=0.05, err_msg=f"d{name}",
+        )
+
+
+def test_gqa_grouped_exchange_layout_and_bytes(devices):
+    """The grouped K/V exchange routes each device exactly its group
+    head's 1/rep sequence shard: content pinned against manual slicing,
+    and per-device KV bytes are rep x SMALLER than the replicating
+    layout's (B, S, 1, H)."""
+    from distributed_pytorch_example_tpu.ops.ulysses import (
+        _grouped_kv_exchange,
+    )
+
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    p, rep, kv = 4, 2, 2
+    B, S, H = 2, 64, 8
+    Sp, c = S // p, S // p // rep
+    rng = np.random.default_rng(11)
+    k = jnp.asarray(rng.standard_normal((B, S, kv, H)), jnp.float32)
+
+    fn = jax.shard_map(
+        lambda x: _grouped_kv_exchange(x, "sequence", rep)[None],
+        mesh=mesh,
+        in_specs=P(None, "sequence", None, None),
+        out_specs=P("sequence"),
+    )
+    per_dev = np.asarray(fn(k))  # (p, B, p, c, H): leading dim = device
+    for d in range(p):
+        g, r = d // rep, d % rep
+        for s in range(p):
+            expect = np.asarray(k)[:, s * Sp + r * c : s * Sp + (r + 1) * c, g]
+            np.testing.assert_array_equal(per_dev[d, :, s], expect)
+    # per-device KV: S/rep positions vs the replicated layout's S
+    local_bytes = per_dev[0].nbytes
+    assert local_bytes == B * (S // rep) * H * 4
+    assert local_bytes * rep == B * S * H * 4  # rep x reduction
+
+
 def test_indivisible_heads_raise(devices):
     mesh = make_mesh(MeshSpec(data=2, sequence=4))
     q, k, v = make_qkv(heads=6)
