@@ -6,10 +6,17 @@ accumulator in float32 VMEM scratch; one MXU matmul per (q-block, k-block)
 pair for logits and one for the value update. Emits the per-row logsumexp so
 the backward pass can reconstruct softmax weights without re-reducing.
 
-Backward: the standard flash backward split into two kernels — one
-accumulating dq over k-blocks, one accumulating (dk, dv) over q-blocks —
-using the saved logsumexp and the precomputed ``delta = rowsum(dO * O)``
-(delta is a cheap elementwise reduce left to XLA, which fuses it).
+Backward: ONE fused kernel on the k-block-major grid computes dq, dk, dv
+from a single logits recompute per block pair, using the saved logsumexp
+and the precomputed ``delta = rowsum(dO * O)`` (a cheap elementwise reduce
+left to XLA, which fuses it). dk/dv accumulate in per-k-block VMEM scratch;
+dq accumulates in a persistent VMEM scratch spanning the q sequence and is
+emitted on each block's last visit (output blocks cannot accumulate across
+non-consecutive revisits — Mosaic does not flush/reload them). When both
+sequences fit one tile, a single-tile variant skips the grid entirely; when
+the dq scratch would exceed ``_FUSED_DQ_VMEM_LIMIT``, the historical
+two-kernel split (separate dq and dk/dv passes, two logits recomputes)
+serves as the fallback.
 
 Causal masking is block-aware: fully-masked (q-block, k-block) pairs skip
 their compute entirely, halving causal FLOPs.
@@ -18,7 +25,7 @@ Layout: (batch, seq, heads, head_dim) at the boundary — transposed to
 (batch, heads, seq, head_dim) internally so the seq x head_dim tiles are
 contiguous MXU operands.
 
-Block sizes default to 512x512 (fastest measured on v5e for head_dim 64 —
+Block sizes default to 1024x1024 (fastest measured on v5e for head_dim 64 —
 see flash_attention()'s docstring; _fit_block shrinks them lane-aligned for
 shorter sequences). ``interpret=True`` runs the same kernels on CPU for
 tests.
@@ -228,6 +235,12 @@ def _vmem(shape, dtype=jnp.float32):
     return pltpu.VMEM(shape, dtype)
 
 
+def _tpu_compiler_params(**kwargs):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(**kwargs)
+
+
 def _fwd_single_kernel(
     *refs, scale: float, causal: bool, block_q: int, block_k: int,
     has_mask: bool,
@@ -385,6 +398,92 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(
+    *refs, scale: float, causal: bool, block_q: int, block_k: int,
+    has_mask: bool,
+):
+    """Multi-block fused backward: dq, dk, dv from ONE logits recompute.
+
+    The separate dq and dk/dv kernels each redo the s = qk^T matmul and
+    the exp — at long sequence the dominant cost. This kernel runs the
+    dkv grid (k-block outer, q-block inner), accumulates dk/dv in VMEM
+    scratch per k-block, and accumulates dq in a PERSISTENT VMEM scratch
+    spanning the whole q sequence (scratch lives across grid steps;
+    output blocks cannot be accumulated across non-consecutive revisits —
+    Mosaic does not flush/reload them, measured silently-wrong). Each dq
+    block is written to the output exactly once, on its last visit
+    (j == nj-1). The scratch costs seq_q*head_dim*4 bytes of VMEM (4 MB
+    at 16k, head_dim 64); _bwd falls back to the two-kernel path beyond
+    _FUSED_DQ_VMEM_LIMIT.
+    """
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, dq_acc) = refs
+        mask_ref = None
+    j, i = pl.program_id(2), pl.program_id(3)  # k-block outer, q-block inner
+    nj = pl.num_programs(2)
+    row = pl.ds(i * block_q, block_q)  # this q-block's slice of dq_acc
+
+    @pl.when(i == 0)
+    def _init_kv():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(j == 0)
+    def _init_dq():
+        dq_acc[row, :] = jnp.zeros((block_q, dq_acc.shape[-1]), jnp.float32)
+
+    needed = ((i + 1) * block_q - 1 >= j * block_k) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # (block_q, 1)
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            s = _apply_causal_mask(s, i, j, block_q, block_k)
+        p = jnp.exp(s - lse)  # (block_q, block_k)
+        if mask_ref is not None:
+            p = jnp.where((mask_ref[0, 0] > 0.0)[None, :], p, 0.0)
+        # dv += p^T @ dO
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        # dk += ds^T @ q
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dq[i] += ds @ k — accumulated in the persistent scratch stripe
+        dq_acc[row, :] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nj - 1)
+    def _emit_dq():
+        dq_ref[0, 0] = dq_acc[row, :].astype(dq_ref.dtype)
+
+    @pl.when(i == pl.num_programs(3) - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 def _bwd_single_kernel(
     *refs, scale: float, causal: bool, block_q: int, block_k: int,
     has_mask: bool,
@@ -470,29 +569,48 @@ def _bwd_single(q, k, v, lse, do, delta, kv_mask, causal, scale, block_q,
     )(*inputs)
 
 
-def _bwd(q, k, v, o, lse, do, kv_mask, causal, scale, block_q, block_k,
-         interpret, delta=None):
+# the fused backward's persistent dq scratch (seq_q * head_dim * 4 bytes)
+# must leave room for the block operands and dk/dv scratch; 8 MB covers
+# 32k tokens at head_dim 64 and stays well inside v5e VMEM
+_FUSED_DQ_VMEM_LIMIT = 8 * 1024 * 1024
+
+
+def _kmajor_specs(kv_mask, block_q, block_k, group, head_dim, inputs):
+    """Shared spec construction for the k-block-major backward grid
+    (j = k-block outer, i = q-block inner) — used by BOTH the fused kernel
+    and the two-kernel fallback so their index maps can never diverge.
+
+    Returns (in_specs, inputs, qspec, kspec_out): qspec doubles as the dq
+    output spec; dK/dV outputs use kspec_out, which indexes PER Q-HEAD
+    (kv blocks are read via the group map, but writes must not race across
+    a group — callers group-sum afterwards).
+    """
+    qspec = pl.BlockSpec(
+        (1, 1, block_q, head_dim), lambda b, n, j, i: (b, n, i, 0)
+    )
+    kspec = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, n, j, i: (b, n // group, j, 0)
+    )
+    kspec_out = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, n, j, i: (b, n, j, 0)
+    )
+    rowspec = pl.BlockSpec((1, 1, block_q, 1), lambda b, n, j, i: (b, n, i, 0))
+    in_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
+    if kv_mask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 1, block_k), lambda b, n, j, i: (b, 0, j))
+        )
+        inputs = inputs + [kv_mask]
+    return in_specs, inputs, qspec, kspec_out
+
+
+def _bwd_split(q, k, v, lse, do, delta, kv_mask, causal, scale, block_q,
+               block_k, interpret):
+    """Separate dq and dk/dv kernels (two logits recomputes): the fallback
+    when the fused kernel's dq scratch would not fit VMEM."""
     batch, heads, seq_q, head_dim = q.shape
     seq_k = k.shape[2]
     group = heads // k.shape[1]
-    if delta is None:
-        delta = jnp.sum(
-            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
-            keepdims=True,
-        )  # (B, N, S, 1), same carry layout as lse
-    # else: caller supplies the global delta (ring attention's chunk
-    # backward, where o/do span ALL chunks but this call sees one)
-    if seq_q == block_q and seq_k == block_k:
-        # both sequences in one tile: fused dq/dk/dv kernel, one logits
-        # recompute + one exp instead of two of each
-        dq, dk, dv = _bwd_single(
-            q, k, v, lse, do, delta, kv_mask, causal, scale, block_q,
-            block_k, interpret,
-        )
-        if group > 1:
-            dk = dk.reshape(batch, k.shape[1], group, seq_k, head_dim).sum(2)
-            dv = dv.reshape(batch, v.shape[1], group, seq_k, head_dim).sum(2)
-        return dq, dk, dv
     has_mask = kv_mask is not None
 
     qspec = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i, j: (b, n, i, 0))
@@ -520,21 +638,10 @@ def _bwd(q, k, v, o, lse, do, kv_mask, causal, scale, block_q, block_k,
     )(*inputs)
 
     # k-block-major grid: q streams innermost
-    qspec_t = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, j, i: (b, n, i, 0))
-    kspec_t = pl.BlockSpec(
-        (1, 1, block_k, head_dim), lambda b, n, j, i: (b, n // group, j, 0)
+    in_specs_t, inputs_t, _, kspec_out = _kmajor_specs(
+        kv_mask, block_q, block_k, group, head_dim,
+        [q, k, v, do, lse, delta],
     )
-    # dK/dV accumulate PER Q-HEAD (kv blocks are read via the group map,
-    # but writes must not race across a group) and are group-summed below
-    kspec_out = pl.BlockSpec(
-        (1, 1, block_k, head_dim), lambda b, n, j, i: (b, n, j, 0)
-    )
-    rowspec_t = pl.BlockSpec((1, 1, block_q, 1), lambda b, n, j, i: (b, n, i, 0))
-    in_specs_t = [qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t, rowspec_t]
-    inputs_t = [q, k, v, do, lse, delta]
-    if has_mask:
-        in_specs_t.append(pl.BlockSpec((1, 1, block_k), lambda b, n, j, i: (b, 0, j)))
-        inputs_t.append(kv_mask)
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
@@ -548,6 +655,78 @@ def _bwd(q, k, v, o, lse, do, kv_mask, causal, scale, block_q, block_k,
             _sds((batch, heads, seq_k, head_dim), v.dtype, q),
         ],
         scratch_shapes=[_vmem((block_k, head_dim)), _vmem((block_k, head_dim))],
+        interpret=interpret,
+    )(*inputs_t)
+    if group > 1:  # GQA: fold the per-q-head contributions into kv heads
+        dk = dk.reshape(batch, k.shape[1], group, seq_k, head_dim).sum(2)
+        dv = dv.reshape(batch, v.shape[1], group, seq_k, head_dim).sum(2)
+    return dq, dk, dv
+
+
+def _bwd(q, k, v, o, lse, do, kv_mask, causal, scale, block_q, block_k,
+         interpret, delta=None):
+    batch, heads, seq_q, head_dim = q.shape
+    seq_k = k.shape[2]
+    group = heads // k.shape[1]
+    if delta is None:
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+            keepdims=True,
+        )  # (B, N, S, 1), same carry layout as lse
+    # else: caller supplies the global delta (ring attention's chunk
+    # backward, where o/do span ALL chunks but this call sees one)
+    if seq_q == block_q and seq_k == block_k:
+        # both sequences in one tile: fused dq/dk/dv kernel, one logits
+        # recompute + one exp instead of two of each
+        dq, dk, dv = _bwd_single(
+            q, k, v, lse, do, delta, kv_mask, causal, scale, block_q,
+            block_k, interpret,
+        )
+        if group > 1:
+            dk = dk.reshape(batch, k.shape[1], group, seq_k, head_dim).sum(2)
+            dv = dv.reshape(batch, v.shape[1], group, seq_k, head_dim).sum(2)
+        return dq, dk, dv
+    has_mask = kv_mask is not None
+    if seq_q * head_dim * 4 > _FUSED_DQ_VMEM_LIMIT:
+        # the fused kernel's persistent dq scratch would crowd VMEM at
+        # this length: fall back to the separate dq and dk/dv kernels
+        return _bwd_split(
+            q, k, v, lse, do, delta, kv_mask, causal, scale, block_q,
+            block_k, interpret,
+        )
+
+    # ONE fused kernel on the k-block-major grid (q streams innermost):
+    # dk/dv accumulate in VMEM scratch per k-block; dq accumulates in a
+    # persistent VMEM scratch spanning the q sequence, emitted on each
+    # block's last visit. One logits recompute + one exp per block pair,
+    # instead of the two of each the separate kernels paid.
+    in_specs_t, inputs_t, qspec_t, kspec_out = _kmajor_specs(
+        kv_mask, block_q, block_k, group, head_dim,
+        [q, k, v, do, lse, delta],
+    )
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_fused_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, has_mask=has_mask,
+        ),
+        grid=(batch, heads, seq_k // block_k, seq_q // block_q),
+        in_specs=in_specs_t,
+        out_specs=[qspec_t, kspec_out, kspec_out],
+        out_shape=[
+            _sds(q.shape, q.dtype, q),
+            _sds((batch, heads, seq_k, head_dim), k.dtype, q),
+            _sds((batch, heads, seq_k, head_dim), v.dtype, q),
+        ],
+        scratch_shapes=[
+            _vmem((block_k, head_dim)),
+            _vmem((block_k, head_dim)),
+            _vmem((seq_q, head_dim)),  # persistent dq accumulator
+        ],
+        # the persistent dq scratch pushes past the 16 MB default scoped
+        # limit at long seq; grant headroom (v5e VMEM is 128 MB physical)
+        compiler_params=_tpu_compiler_params(
+            vmem_limit_bytes=32 * 1024 * 1024
+        ),
         interpret=interpret,
     )(*inputs_t)
     if group > 1:  # GQA: fold the per-q-head contributions into kv heads

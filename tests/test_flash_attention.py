@@ -52,6 +52,79 @@ def test_grads_match_xla(causal):
         )
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("gqa", [False, True])
+@pytest.mark.parametrize("masked", [False, True])
+def test_multiblock_fused_backward_grads(causal, gqa, masked):
+    """The fused multi-block backward (one logits recompute for dq/dk/dv,
+    persistent dq scratch): explicit 128x64 blocks at seq 256 force the
+    multi-block grid the default-blocks tests never reach."""
+    rng = np.random.default_rng(21)
+    q = jnp.asarray(rng.standard_normal((2, 256, 4, 64)), jnp.float32)
+    kvh = 2 if gqa else 4
+    k = jnp.asarray(rng.standard_normal((2, 256, kvh, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 256, kvh, 64)), jnp.float32)
+    kv_mask = make_kv_mask(seq=256, seed=22) if masked else None
+    scale = 64 ** -0.5
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            _xla_attention(q, k, v, None, kv_mask, causal, scale) ** 2
+        )
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=causal, kv_mask=kv_mask, interpret=True,
+                block_q=128, block_k=64,
+            ) ** 2
+        )
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ref, g_flash, "qkv"):
+        assert gf.shape == gr.shape
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_multiblock_split_fallback_grads(causal, monkeypatch):
+    """The two-kernel fallback (_bwd_split, used when the fused kernel's
+    dq scratch would exceed VMEM) must stay numerically identical — forced
+    here by shrinking the limit below seq*head_dim*4."""
+    from distributed_pytorch_example_tpu.ops.pallas import flash_attention as fa
+
+    monkeypatch.setattr(fa, "_FUSED_DQ_VMEM_LIMIT", 0)
+    rng = np.random.default_rng(23)
+    q = jnp.asarray(rng.standard_normal((2, 256, 4, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 256, 2, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 256, 2, 64)), jnp.float32)
+    kv_mask = make_kv_mask(seq=256, seed=24)
+    scale = 64 ** -0.5
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            _xla_attention(q, k, v, None, kv_mask, causal, scale) ** 2
+        )
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            fa.flash_attention(
+                q, k, v, causal=causal, kv_mask=kv_mask, interpret=True,
+                block_q=128, block_k=64,
+            ) ** 2
+        )
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ref, g_flash, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
 def test_uneven_blocks_rejected():
     q, k, v = make_qkv(seq=200)
     with pytest.raises(ValueError):
