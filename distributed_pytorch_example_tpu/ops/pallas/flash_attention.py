@@ -42,6 +42,11 @@ from jax.experimental import pallas as pl
 
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() NaN-free
 
+# measured-fastest block size on v5e for head_dim 64 (see
+# flash_attention()'s docstring); ring attention's local folds import this
+# so a retune happens in ONE place
+DEFAULT_BLOCK = 1024
+
 
 def _fit_block(seq: int, requested: int) -> int:
     """Largest block <= requested that divides seq (lane-aligned when possible)."""
@@ -772,8 +777,8 @@ def flash_attention(
     causal: bool = False,
     kv_mask: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
     interpret: bool = False,
 ) -> jax.Array:
     """Fused flash attention; (B, S, N, H) in and out.

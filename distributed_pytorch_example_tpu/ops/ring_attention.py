@@ -146,12 +146,13 @@ def _chunk_fwd_flash(q, k, v, mask, scale, causal, idx, src, interpret):
     no-op).
     """
     from distributed_pytorch_example_tpu.ops.pallas.flash_attention import (
+        DEFAULT_BLOCK,
         _fit_block,
         _fwd,
     )
 
     s_loc = q.shape[1]
-    block = _fit_block(s_loc, 512)  # must DIVIDE s_loc, not just cap it
+    block = _fit_block(s_loc, DEFAULT_BLOCK)  # must DIVIDE s_loc, not just cap it
     kvm = None if mask is None else mask.astype(jnp.float32)[:, None, :]
 
     def run(causal_flag):
@@ -190,12 +191,13 @@ def _chunk_bwd_flash(q, k, v, mask, g, lse, delta, scale, causal, idx, src,
                      interpret):
     """Pallas-flash chunk backward from the global lse/delta."""
     from distributed_pytorch_example_tpu.ops.pallas.flash_attention import (
+        DEFAULT_BLOCK,
         _bwd,
         _fit_block,
     )
 
     s_loc = q.shape[1]
-    block = _fit_block(s_loc, 512)  # must DIVIDE s_loc, not just cap it
+    block = _fit_block(s_loc, DEFAULT_BLOCK)  # must DIVIDE s_loc, not just cap it
     kvm = None if mask is None else mask.astype(jnp.float32)[:, None, :]
 
     def run(causal_flag):
