@@ -73,26 +73,47 @@ def _apply_causal_mask(s, i, j, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
+def _fold_fwd_coords(ip, jj, ni):
+    """Folded causal grid -> (i, j): q-block row ``ip`` (short, j <= ip)
+    pairs with row ``ni-1-ip`` (long) so every grid step is a needed
+    lower-triangular pair — jj sweeps row_a's j in [0, ip], then row_b's
+    j in [0, ni-1-ip], ni+1 steps total per ip."""
+    on_a = jj <= ip
+    i = jnp.where(on_a, ip, ni - 1 - ip)
+    j = jnp.where(on_a, jj, jj - ip - 1)
+    return i, j
+
+
 def _fwd_kernel(
     *refs, scale: float, causal: bool, block_q: int, block_k: int,
-    has_mask: bool,
+    has_mask: bool, folded: bool = False,
 ):
     if has_mask:
         q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     else:
         q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
         mask_ref = None
-    i, j = pl.program_id(2), pl.program_id(3)
-    nj = pl.num_programs(3)
+    if folded:
+        # causal triangular schedule: no skipped steps (see _fold_fwd_coords)
+        ip, jj = pl.program_id(2), pl.program_id(3)
+        ni = pl.num_programs(2) * 2
+        i, j = _fold_fwd_coords(ip, jj, ni)
+        init_cond = (jj == 0) | (jj == ip + 1)
+        fin_cond = (jj == ip) | (jj == pl.num_programs(3) - 1)
+        needed = True
+    else:
+        i, j = pl.program_id(2), pl.program_id(3)
+        nj = pl.num_programs(3)
+        init_cond = j == 0
+        fin_cond = j == nj - 1
+        # causal: skip blocks strictly above the diagonal
+        needed = (j * block_k <= (i + 1) * block_q - 1) if causal else True
 
-    @pl.when(j == 0)
+    @pl.when(init_cond)
     def _init():
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    # causal: skip blocks strictly above the diagonal
-    needed = (j * block_k <= (i + 1) * block_q - 1) if causal else True
 
     @pl.when(needed)
     def _compute():
@@ -121,7 +142,7 @@ def _fwd_kernel(
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
-    @pl.when(j == nj - 1)
+    @pl.when(fin_cond)
     def _finalize():
         l = l_ref[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
@@ -149,33 +170,62 @@ def _fwd(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret):
         return _fwd_single(
             q, k, v, kv_mask, causal, scale, block_q, block_k, interpret
         )
-    grid = (batch, heads, seq_q // block_q, seq_k // block_k)
-
-    qspec = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i, j: (b, n, i, 0))
-    kspec = pl.BlockSpec(
-        (1, 1, block_k, head_dim), lambda b, n, i, j: (b, n // group, j, 0)
+    ni = seq_q // block_q
+    folded = (
+        causal and seq_q == seq_k and block_q == block_k and ni % 2 == 0
     )
+    if folded:
+        # triangular schedule: pair q-block rows so every grid step is a
+        # needed causal pair — ni*(ni/2+...) -> (ni/2)*(ni+1) steps instead
+        # of ni^2 with ~half skipped (skipped steps still paid their grid
+        # overhead + block DMA: ~18% of the 16k backward, measured)
+        grid = (batch, heads, ni // 2, ni + 1)
+
+        def qmap(b, n, ip, jj):
+            i, _ = _fold_fwd_coords(ip, jj, ni)
+            return (b, n, i, 0)
+
+        def kmap(b, n, ip, jj):
+            _, j = _fold_fwd_coords(ip, jj, ni)
+            return (b, n // group, j, 0)
+
+        def mmap(b, n, ip, jj):
+            _, j = _fold_fwd_coords(ip, jj, ni)
+            return (b, 0, j)
+    else:
+        grid = (batch, heads, ni, seq_k // block_k)
+
+        def qmap(b, n, i, j):
+            return (b, n, i, 0)
+
+        def kmap(b, n, i, j):
+            return (b, n // group, j, 0)
+
+        def mmap(b, n, i, j):
+            return (b, 0, j)
+
+    qspec = pl.BlockSpec((1, 1, block_q, head_dim), qmap)
+    kspec = pl.BlockSpec((1, 1, block_k, head_dim), kmap)
     has_mask = kv_mask is not None
     in_specs = [qspec, kspec, kspec]
     inputs = [q, k, v]
     if has_mask:
-        in_specs.append(
-            pl.BlockSpec((1, 1, block_k), lambda b, n, i, j: (b, 0, j))
-        )
+        in_specs.append(pl.BlockSpec((1, 1, block_k), mmap))
         inputs.append(kv_mask)
 
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, has_mask=has_mask,
+            folded=folded,
         ),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, head_dim), lambda b, n, i, j: (b, n, i, 0)),
+            qspec,
             # lse rides as (B, N, S, 1): block (…, block_q, 1) satisfies the
             # TPU tile rule (last dim == array dim, 2nd-to-last % 8 == 0)
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, n, i, j: (b, n, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), qmap),
         ],
         out_shape=[
             _sds(q.shape, q.dtype, q),
@@ -403,9 +453,20 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _fold_bwd_coords(jp, ii, ni):
+    """Folded causal grid for the k-outer backward: short column ``jp``
+    (rows i in [jp, ni-1]) pairs with long column ``ni-1-jp`` (rows
+    i in [ni-1-jp, ni-1]) — ii sweeps column_a's rows then column_b's,
+    ni+1 steps per jp, every one a needed lower-triangular pair."""
+    on_a = ii < ni - jp
+    j = jnp.where(on_a, jp, ni - 1 - jp)
+    i = jnp.where(on_a, jp + ii, ii - 1)
+    return i, j, on_a
+
+
 def _bwd_fused_kernel(
     *refs, scale: float, causal: bool, block_q: int, block_k: int,
-    has_mask: bool,
+    has_mask: bool, folded: bool = False,
 ):
     """Multi-block fused backward: dq, dk, dv from ONE logits recompute.
 
@@ -416,9 +477,12 @@ def _bwd_fused_kernel(
     spanning the whole q sequence (scratch lives across grid steps;
     output blocks cannot be accumulated across non-consecutive revisits —
     Mosaic does not flush/reload them, measured silently-wrong). Each dq
-    block is written to the output exactly once, on its last visit
-    (j == nj-1). The scratch costs seq_q*head_dim*4 bytes of VMEM (4 MB
-    at 16k, head_dim 64); _bwd falls back to the two-kernel path beyond
+    block is written to the output exactly once, on its last visit: the
+    final k-block sweep (j == nj-1) on the square grid, or the per-row
+    last-touch conditions of the triangular schedule when ``folded`` (its
+    own diagonal step for rows < ni/2, the final jp's long column for the
+    rest). The scratch costs seq_q*head_dim*4 bytes of VMEM (4 MB at 16k,
+    head_dim 64); _bwd falls back to the two-kernel path beyond
     _FUSED_DQ_VMEM_LIMIT.
     """
     if has_mask:
@@ -428,20 +492,39 @@ def _bwd_fused_kernel(
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, dq_acc) = refs
         mask_ref = None
-    j, i = pl.program_id(2), pl.program_id(3)  # k-block outer, q-block inner
-    nj = pl.num_programs(2)
+    if folded:
+        # causal triangular schedule (see _fold_bwd_coords): every step is
+        # a needed pair. Scratch lifecycles: column_a runs ii in [0, ni-jp),
+        # column_b in [ni-jp, ni]; dq rows are all first-touched (and
+        # zeroed) during jp==0's column_a sweep, and each row's LAST touch
+        # is either its own diagonal step (rows < ni/2: on_a, ii==0 at
+        # jp==row) or the final jp's column_b (rows >= ni/2) — emit there.
+        jp, ii = pl.program_id(2), pl.program_id(3)
+        njp = pl.num_programs(2)
+        ni = pl.num_programs(3) - 1
+        i, j, on_a = _fold_bwd_coords(jp, ii, ni)
+        init_kv = (ii == 0) | (ii == ni - jp)
+        fin_kv = (ii == ni - jp - 1) | (ii == ni)
+        init_dq = (jp == 0) & on_a
+        emit_dq = (on_a & (ii == 0)) | ((jp == njp - 1) & ~on_a)
+        needed = True
+    else:
+        j, i = pl.program_id(2), pl.program_id(3)  # k outer, q inner
+        init_kv = i == 0
+        fin_kv = i == pl.num_programs(3) - 1
+        init_dq = j == 0
+        emit_dq = j == pl.num_programs(2) - 1
+        needed = ((i + 1) * block_q - 1 >= j * block_k) if causal else True
     row = pl.ds(i * block_q, block_q)  # this q-block's slice of dq_acc
 
-    @pl.when(i == 0)
+    @pl.when(init_kv)
     def _init_kv():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    @pl.when(j == 0)
+    @pl.when(init_dq)
     def _init_dq():
         dq_acc[row, :] = jnp.zeros((block_q, dq_acc.shape[-1]), jnp.float32)
-
-    needed = ((i + 1) * block_q - 1 >= j * block_k) if causal else True
 
     @pl.when(needed)
     def _compute():
@@ -479,11 +562,11 @@ def _bwd_fused_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(j == nj - 1)
+    @pl.when(emit_dq)
     def _emit_dq():
         dq_ref[0, 0] = dq_acc[row, :].astype(dq_ref.dtype)
 
-    @pl.when(i == pl.num_programs(3) - 1)
+    @pl.when(fin_kv)
     def _finalize():
         dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
@@ -705,16 +788,53 @@ def _bwd(q, k, v, o, lse, do, kv_mask, causal, scale, block_q, block_k,
     # persistent VMEM scratch spanning the q sequence, emitted on each
     # block's last visit. One logits recompute + one exp per block pair,
     # instead of the two of each the separate kernels paid.
-    in_specs_t, inputs_t, qspec_t, kspec_out = _kmajor_specs(
-        kv_mask, block_q, block_k, group, head_dim,
-        [q, k, v, do, lse, delta],
+    ni = seq_q // block_q
+    folded = (
+        causal and seq_q == seq_k and block_q == block_k and ni % 2 == 0
     )
+    if folded:
+        # triangular schedule (see _fold_bwd_coords): ~half the grid steps
+        grid = (batch, heads, ni // 2, ni + 1)
+
+        def fqmap(b, n, jp, ii):
+            i, _, _ = _fold_bwd_coords(jp, ii, ni)
+            return (b, n, i, 0)
+
+        def fkmap(b, n, jp, ii):
+            _, j, _ = _fold_bwd_coords(jp, ii, ni)
+            return (b, n // group, j, 0)
+
+        def fkout(b, n, jp, ii):
+            _, j, _ = _fold_bwd_coords(jp, ii, ni)
+            return (b, n, j, 0)
+
+        def fmmap(b, n, jp, ii):
+            _, j, _ = _fold_bwd_coords(jp, ii, ni)
+            return (b, 0, j)
+
+        qspec_t = pl.BlockSpec((1, 1, block_q, head_dim), fqmap)
+        kspec_f = pl.BlockSpec((1, 1, block_k, head_dim), fkmap)
+        kspec_out = pl.BlockSpec((1, 1, block_k, head_dim), fkout)
+        rowspec_f = pl.BlockSpec((1, 1, block_q, 1), fqmap)
+        in_specs_t = [qspec_t, kspec_f, kspec_f, qspec_t, rowspec_f,
+                      rowspec_f]
+        inputs_t = [q, k, v, do, lse, delta]
+        if has_mask:
+            in_specs_t.append(pl.BlockSpec((1, 1, block_k), fmmap))
+            inputs_t.append(kv_mask)
+    else:
+        grid = (batch, heads, seq_k // block_k, ni)
+        in_specs_t, inputs_t, qspec_t, kspec_out = _kmajor_specs(
+            kv_mask, block_q, block_k, group, head_dim,
+            [q, k, v, do, lse, delta],
+        )
     dq, dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_fused_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, has_mask=has_mask,
+            folded=folded,
         ),
-        grid=(batch, heads, seq_k // block_k, seq_q // block_q),
+        grid=grid,
         in_specs=in_specs_t,
         out_specs=[qspec_t, kspec_out, kspec_out],
         out_shape=[

@@ -89,6 +89,50 @@ def test_multiblock_fused_backward_grads(causal, gqa, masked):
         )
 
 
+@pytest.mark.parametrize("gqa", [False, True])
+@pytest.mark.parametrize("masked", [False, True])
+@pytest.mark.parametrize("ni", [2, 4, 6])
+def test_folded_causal_grid_forward_and_grads(gqa, masked, ni):
+    """The triangular (folded) causal schedule — equal square blocks, even
+    block count — must match the XLA reference exactly like the square
+    grid it replaces (every grid step a needed pair, no skipped ticks)."""
+    seq = 128 * ni
+    rng = np.random.default_rng(31 + ni)
+    q = jnp.asarray(rng.standard_normal((2, seq, 4, 64)), jnp.float32)
+    kvh = 2 if gqa else 4
+    k = jnp.asarray(rng.standard_normal((2, seq, kvh, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, seq, kvh, 64)), jnp.float32)
+    kv_mask = make_kv_mask(seq=seq, seed=32) if masked else None
+    scale = 64 ** -0.5
+
+    expected = _xla_attention(q, k, v, None, kv_mask, True, scale)
+    got = flash_attention(
+        q, k, v, causal=True, kv_mask=kv_mask, interpret=True,
+        block_q=128, block_k=128,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            _xla_attention(q, k, v, None, kv_mask, True, scale) ** 2
+        )
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, kv_mask=kv_mask, interpret=True,
+                block_q=128, block_k=128,
+            ) ** 2
+        )
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ref, g_flash, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_multiblock_split_fallback_grads(causal, monkeypatch):
     """The two-kernel fallback (_bwd_split, used when the fused kernel's
