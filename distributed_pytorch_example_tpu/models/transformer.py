@@ -210,14 +210,19 @@ class MultiHeadAttention(nn.Module):
         from distributed_pytorch_example_tpu.runtime.mesh import current_mesh
 
         mesh = current_mesh()
-        if mesh is None:
+        if mesh is None or self.seq_axis not in mesh.axis_names:
+            # a mesh that lacks the axis entirely is the missing-context
+            # case too (framework meshes always carry every axis, span-1
+            # axes included) — silently tracing the dense path here would
+            # materialize the S x S logits the user sharded to avoid
             raise RuntimeError(
                 f"seq_axis={self.seq_axis!r} requires an active `with mesh:` "
-                "context (Trainer enters it automatically; wrap manual "
-                "apply() calls yourself)."
+                "context whose mesh has that axis (Trainer.train_epoch "
+                "enters it automatically; wrap manual apply()/train_step "
+                "calls yourself)."
             )
-        if mesh.shape.get(self.seq_axis, 1) <= 1:
-            return None  # mesh has no sequence span: dense path is exact
+        if mesh.shape[self.seq_axis] <= 1:
+            return None  # axis present but span 1: dense path is exact
         return mesh
 
 

@@ -141,9 +141,11 @@ def test_trainer_actually_uses_ring(devices, monkeypatch, tmp_path):
     loader = DeviceLoader(ds, 4, mesh=mesh, num_shards=1, shard_id=0)
     trainer = Trainer(model, CausalLMTask(), optax.adam(1e-3),
                       partitioner=data_parallel(mesh))
-    trainer.init(next(iter(loader))["tokens"])
-    batch = next(iter(loader))
-    trainer.train_step(trainer.state, batch)
+    it = iter(loader)
+    trainer.init(next(it)["tokens"])  # Trainer enters the mesh itself
+    calls.clear()  # prove the TRAIN STEP traces ring, not just init
+    with mesh:  # raw train_step bypasses Trainer._mesh_ctx: caller's job
+        trainer.train_step(trainer.state, next(it))
     assert calls, "ring_attention_sharded was never invoked via the Trainer"
 
 
