@@ -43,6 +43,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_pytorch_example_tpu.ops.attention import dot_product_attention
 
+# (kv_heads, axis_size) pairs already warned about use_flash on the grouped
+# GQA path — without this the warning fires once per attention layer per trace
+_flash_warned: set = set()
+
 NEG_INF = -1e30  # large-negative instead of -inf keeps exp() NaN-free
 
 
@@ -92,7 +96,8 @@ def ulysses_attention(
             )
         # GQA with fewer kv heads than devices: grouped exchange keeps
         # per-device KV at the fair kv_heads/P share (no replication)
-        if use_flash:
+        if use_flash and (kv_heads, p) not in _flash_warned:
+            _flash_warned.add((kv_heads, p))
             from distributed_pytorch_example_tpu.runtime.logging import (
                 get_logger,
             )

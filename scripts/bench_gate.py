@@ -65,7 +65,13 @@ def _extract_models(blob: str, source: str) -> dict[str, dict]:
 
 
 def _latest_bench(root: str) -> str:
-    files = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    # sort by parsed round number, not filename (lexicographic mis-orders
+    # once rounds outgrow the zero-padding: r100 < r99)
+    def round_no(path):
+        m = re.search(r"r(\d+)", os.path.basename(path))
+        return int(m.group(1)) if m else -1
+
+    files = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")), key=round_no)
     if not files:
         raise SystemExit("bench_gate: no BENCH_r*.json found and no --prev")
     return files[-1]

@@ -165,6 +165,18 @@ def test_tolerance_flag(tmp_path):
     assert rc == 0
 
 
+def test_latest_bench_sorts_numerically(tmp_path):
+    """r100 must beat r99 (lexicographic sort picks r99)."""
+    sys.path.insert(0, os.path.dirname(GATE))
+    try:
+        from bench_gate import _latest_bench
+    finally:
+        sys.path.pop(0)
+    for name in ("BENCH_r99.json", "BENCH_r100.json", "BENCH_r04.json"):
+        (tmp_path / name).write_text("{}")
+    assert _latest_bench(str(tmp_path)).endswith("BENCH_r100.json")
+
+
 def test_not_a_bench_payload(tmp_path):
     prev_path = tmp_path / "prev.json"
     prev_path.write_text(json.dumps({"nonsense": True}))
