@@ -39,6 +39,12 @@ class GPT2(nn.Module):
     moe_capacity_factor: float = 1.25
     pipe_axis: Optional[str] = None  # mesh axis for pipeline stages (PP)
     pipe_microbatches: int = 0  # 0 = auto
+    # "gpipe": all-forward-then-backward (autodiff through the schedule).
+    # "1f1b": interleaved one-forward-one-backward — activation stash
+    # bounded by ~n_stages instead of ~n_micro (parallel/pipeline.py);
+    # train calls must pass ``targets`` (the loss runs inside the
+    # schedule); eval still uses the GPipe forward.
+    pipe_schedule: str = "gpipe"
     decode: bool = False  # autoregressive KV-cache mode (train/generate.py)
     # "full": return (B, S, V) logits. "hidden": return the final hidden
     # states instead, for the fused chunked-CE loss (train/tasks.py pairs
@@ -51,18 +57,27 @@ class GPT2(nn.Module):
         return params["wte"]["embedding"], None
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False):
+    def __call__(self, tokens, *, train: bool = False, targets=None):
         if self.logits_mode not in ("full", "hidden"):
             raise ValueError(
                 f"logits_mode must be 'full' or 'hidden', got "
                 f"{self.logits_mode!r}"
             )
+        from distributed_pytorch_example_tpu.models.stacked import (
+            validate_pipe_schedule,
+        )
+
+        validate_pipe_schedule(self, targets)
         if self.decode and self.logits_mode != "full":
             raise ValueError("decode mode requires logits_mode='full'")
-        if self.pipe_axis is not None and self.seq_axis:
+        if (
+            self.pipe_axis is not None
+            and self.seq_axis
+            and self.moe_experts
+        ):
             raise ValueError(
-                "pipe_axis cannot combine with seq_axis yet (the pipeline "
-                "stages are whole-sequence blocks)"
+                "pipe_axis + seq_axis + moe_experts (PP x SP x EP in one "
+                "stack) is not supported; drop one axis"
             )
         if (
             self.pipe_axis is not None
@@ -121,7 +136,7 @@ class GPT2(nn.Module):
                 StackedDecoder,
             )
 
-            x = StackedDecoder(
+            decoder = StackedDecoder(
                 num_layers=self.num_layers,
                 num_heads=self.num_heads,
                 head_dim=self.model_dim // self.num_heads,
@@ -134,11 +149,18 @@ class GPT2(nn.Module):
                 remat=self.remat,
                 pipe_axis=self.pipe_axis,
                 pipe_microbatches=self.pipe_microbatches,
+                seq_axis=self.seq_axis,
+                sp_mode=self.sp_mode,
                 moe_experts=self.moe_experts,
                 moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,
                 name="decoder",
-            )(x, train=train)
+            )
+            if self.pipe_schedule == "1f1b":
+                return self._run_1f1b(
+                    decoder, x, embed.embedding, targets, train
+                )
+            x = decoder(x, train=train)
         else:
             x = TransformerStack(
                 num_layers=self.num_layers,
@@ -170,3 +192,48 @@ class GPT2(nn.Module):
         )
 
         return tied_head_logits(x, embed.embedding, self.dtype)
+
+    def _run_1f1b(self, decoder, x, embed_table, targets, train):
+        """1F1B schedule paths: train-with-targets runs the loss inside
+        the pipeline (parallel/pipeline.py one_f_one_b); eval keeps the
+        GPipe forward. The final LN is owned as raw params (NormParams,
+        same tree as nn.LayerNorm) so it can run inside ``last_fn``.
+        """
+        from distributed_pytorch_example_tpu.models.stacked import (
+            NormParams,
+            _layer_norm,
+        )
+
+        scale, bias = NormParams(self.model_dim, name="final_ln")()
+        dtype = self.dtype
+        eps = 1e-5
+        if targets is None or self.is_initializing():
+            x = decoder(x, train=train)
+            x = _layer_norm(x, scale, bias, eps, dtype)
+            if self.logits_mode == "hidden":
+                return x
+            from distributed_pytorch_example_tpu.models.transformer import (
+                tied_head_logits,
+            )
+
+            return tied_head_logits(x, embed_table, dtype)
+
+        from distributed_pytorch_example_tpu.ops.chunked_ce import (
+            chunked_softmax_xent,
+        )
+
+        def last_fn(lp, y, tok_mb):
+            sc, bs, table = lp
+            h = _layer_norm(y, sc, bs, eps, dtype)
+            tg = tok_mb[:, 1:]
+            per_tok, argmax = chunked_softmax_xent(
+                h[:, :-1], table, tg, bias=None, dtype=dtype
+            )
+            correct = (argmax == tg).sum().astype(jnp.float32)
+            return per_tok.mean(), {"correct": correct}
+
+        loss_sum, mets, _aux, n_micro = decoder(
+            x, train=train,
+            last=(last_fn, (scale, bias, embed_table), targets),
+        )
+        return loss_sum / n_micro, mets

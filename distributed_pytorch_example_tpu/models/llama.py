@@ -145,6 +145,8 @@ class Llama(nn.Module):
     remat: bool = False
     pipe_axis: Optional[str] = None  # mesh axis for pipeline stages (PP)
     pipe_microbatches: int = 0  # 0 = auto
+    # "gpipe" | "1f1b" — see models/gpt2.py pipe_schedule
+    pipe_schedule: str = "gpipe"
     moe_experts: int = 0  # >0: Mixtral-style MoE on every moe_every-th block
     moe_every: int = 2
     moe_top_k: int = 2  # Mixtral default: 2 experts per token
@@ -161,18 +163,27 @@ class Llama(nn.Module):
         return jnp.swapaxes(params["lm_head"], 0, 1), None
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False):
+    def __call__(self, tokens, *, train: bool = False, targets=None):
         if self.logits_mode not in ("full", "hidden"):
             raise ValueError(
                 f"logits_mode must be 'full' or 'hidden', got "
                 f"{self.logits_mode!r}"
             )
+        from distributed_pytorch_example_tpu.models.stacked import (
+            validate_pipe_schedule,
+        )
+
+        validate_pipe_schedule(self, targets)
         if self.decode and self.logits_mode != "full":
             raise ValueError("decode mode requires logits_mode='full'")
-        if self.pipe_axis is not None and self.seq_axis:
+        if (
+            self.pipe_axis is not None
+            and self.seq_axis
+            and self.moe_experts
+        ):
             raise ValueError(
-                "pipe_axis cannot combine with seq_axis yet (the pipeline "
-                "stages are whole-sequence blocks)"
+                "pipe_axis + seq_axis + moe_experts (PP x SP x EP in one "
+                "stack) is not supported; drop one axis"
             )
         if (
             self.pipe_axis is not None
@@ -210,7 +221,7 @@ class Llama(nn.Module):
                 StackedLlamaDecoder,
             )
 
-            x = StackedLlamaDecoder(
+            decoder = StackedLlamaDecoder(
                 num_layers=self.num_layers,
                 num_heads=self.num_heads,
                 num_kv_heads=self.num_kv_heads,
@@ -224,11 +235,16 @@ class Llama(nn.Module):
                 remat=self.remat,
                 pipe_axis=self.pipe_axis,
                 pipe_microbatches=self.pipe_microbatches,
+                seq_axis=self.seq_axis,
+                sp_mode=self.sp_mode,
                 moe_experts=self.moe_experts,
                 moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,
                 name="decoder",
-            )(x, train=train)
+            )
+            if self.pipe_schedule == "1f1b":
+                return self._run_1f1b(decoder, x, targets, train)
+            x = decoder(x, train=train)
             return self._head(x)
 
         for i in range(self.num_layers):
@@ -261,6 +277,54 @@ class Llama(nn.Module):
             else:
                 x = block(x, train=train)
         return self._head(x)
+
+    def _run_1f1b(self, decoder, x, targets, train):
+        """1F1B paths (see models/gpt2.py _run_1f1b): final RMSNorm and the
+        untied head owned as raw params so the loss runs inside the
+        schedule's ``last_fn``; eval keeps the GPipe forward."""
+        from distributed_pytorch_example_tpu.models.stacked import (
+            NormParams,
+            _rms_norm,
+        )
+
+        (scale,) = NormParams(self.model_dim, bias=False, name="final_ln")()
+        head = self.param(
+            "lm_head",
+            nn.initializers.normal(stddev=0.02),
+            (self.model_dim, self.vocab_size),
+        )
+        dtype = self.dtype
+        eps = 1e-5
+        if targets is None or self.is_initializing():
+            x = decoder(x, train=train)
+            x = _rms_norm(x, scale, eps, dtype)
+            if self.logits_mode == "hidden":
+                return x
+            return jax.lax.dot_general(
+                x, head.astype(dtype),
+                (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        from distributed_pytorch_example_tpu.ops.chunked_ce import (
+            chunked_softmax_xent,
+        )
+
+        def last_fn(lp, y, tok_mb):
+            sc, hd = lp
+            h = _rms_norm(y, sc, eps, dtype)
+            tg = tok_mb[:, 1:]
+            per_tok, argmax = chunked_softmax_xent(
+                h[:, :-1], jnp.swapaxes(hd, 0, 1), tg, bias=None,
+                dtype=dtype,
+            )
+            correct = (argmax == tg).sum().astype(jnp.float32)
+            return per_tok.mean(), {"correct": correct}
+
+        loss_sum, mets, _aux, n_micro = decoder(
+            x, train=train, last=(last_fn, (scale, head), targets)
+        )
+        return loss_sum / n_micro, mets
 
     def _head(self, x):
         x = RMSNorm(1e-5, self.dtype, name="final_ln")(x)

@@ -56,6 +56,58 @@ def _rms_norm(x, scale, eps, dtype):
     return (x32 * lax.rsqrt(var + eps) * scale.astype(jnp.float32)).astype(dtype)
 
 
+def validate_pipe_schedule(mod, targets):
+    """Shared pipe_schedule/targets validation for the pipelined LMs
+    (GPT-2 and LLaMA carry identical constraints; one copy here so the
+    next schedule capability is lifted in one place)."""
+    if mod.pipe_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"pipe_schedule must be 'gpipe' or '1f1b', got "
+            f"{mod.pipe_schedule!r}"
+        )
+    if mod.pipe_schedule == "1f1b":
+        if mod.pipe_axis is None:
+            raise ValueError("pipe_schedule='1f1b' requires pipe_axis")
+        if mod.moe_experts:
+            raise ValueError(
+                "pipe_schedule='1f1b' does not serve MoE yet; use the "
+                "GPipe schedule for MoE pipelines"
+            )
+        if mod.seq_axis:
+            raise ValueError(
+                "pipe_schedule='1f1b' does not compose with seq_axis yet "
+                "(the in-schedule loss would need sequence-chunked CE); "
+                "use the GPipe schedule for SP x PP"
+            )
+    elif targets is not None:
+        raise ValueError(
+            "targets are only consumed by the 1F1B schedule (the loss "
+            "runs inside the pipeline); use the task's outer loss "
+            "otherwise"
+        )
+
+
+class NormParams(nn.Module):
+    """Owns a final-norm's parameters WITHOUT applying them.
+
+    The 1F1B path needs the final norm as raw arrays (it runs inside the
+    schedule's ``last_fn``, not as a flax submodule call); this module
+    creates the same param tree as ``nn.LayerNorm`` / ``RMSNorm`` would
+    (names ``scale``/``bias``, ones/zeros init) so checkpoints are
+    interchangeable between schedules.
+    """
+
+    dim: int
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self):
+        scale = self.param("scale", nn.initializers.ones, (self.dim,))
+        if not self.bias:
+            return (scale,)
+        return scale, self.param("bias", nn.initializers.zeros, (self.dim,))
+
+
 def _pipe_size(pipe_axis) -> int:
     """Pipeline span of the active mesh (0/1 = run sequentially)."""
     if pipe_axis is None:
@@ -70,6 +122,92 @@ def _pipe_size(pipe_axis) -> int:
             "apply() calls yourself)."
         )
     return mesh.shape.get(pipe_axis, 1)
+
+
+def _sp_attention(mod, q, k, v, scale, causal, local):
+    """Attention dispatch for stacked decoders: dense, ring, or Ulysses.
+
+    ``local=True`` — the pipelined case: the whole stage already runs in
+    ONE shard_map manual over {pipe, seq_axis} (parallel/pipeline.py
+    ``seq_axis``), q/k/v arrive as sequence-LOCAL chunks, and the dispatch
+    calls the chunk-local SP collectives (``ring_attention`` /
+    ``ulysses_attention`` with ``axis_name``) directly. No nested
+    shard_map: differentiating through nested shard_maps with custom-VJP
+    bodies mis-builds residual shardings (duplicate-axis PartitionSpecs)
+    in jax 0.9.
+
+    ``local=False`` — pipe span 1: activations are global; the classic
+    sharded wrappers open their own (single-level) manual region.
+    """
+    mesh = _sp_mesh(mod.seq_axis)
+    if mesh is None:
+        return dot_product_attention(
+            q, k, v, causal=causal, softmax_scale=scale,
+            use_flash=mod.use_flash,
+        )
+    if mod.sp_mode not in ("ring", "ulysses"):
+        raise ValueError(
+            f"sp_mode must be 'ring' or 'ulysses', got {mod.sp_mode!r}"
+        )
+    if local:
+        if mod.sp_mode == "ulysses":
+            from distributed_pytorch_example_tpu.ops.ulysses import (
+                ulysses_attention,
+            )
+
+            return ulysses_attention(
+                q, k, v, mod.seq_axis, causal=causal, softmax_scale=scale,
+                use_flash=mod.use_flash,
+            )
+        from distributed_pytorch_example_tpu.ops.ring_attention import (
+            ring_attention,
+        )
+
+        return ring_attention(
+            q, k, v, mod.seq_axis, causal=causal, softmax_scale=scale,
+            use_flash=mod.use_flash,
+        )
+    if mod.sp_mode == "ulysses":
+        from distributed_pytorch_example_tpu.ops.ulysses import (
+            ulysses_attention_sharded,
+        )
+
+        return ulysses_attention_sharded(
+            q, k, v, mesh, seq_axis=mod.seq_axis, causal=causal,
+            softmax_scale=scale, use_flash=mod.use_flash,
+        )
+    from distributed_pytorch_example_tpu.ops.ring_attention import (
+        ring_attention_sharded,
+    )
+
+    return ring_attention_sharded(
+        q, k, v, mesh, seq_axis=mod.seq_axis, causal=causal,
+        softmax_scale=scale, use_flash=mod.use_flash,
+    )
+
+
+def _sp_mesh(seq_axis):
+    """The active mesh when sequence parallelism should run, else None.
+
+    Mirrors models/transformer.py _ring_mesh: ``seq_axis`` set with no
+    active mesh context is a loud error (silently tracing dense would
+    materialize the S x S logits the user sharded to avoid); an axis of
+    span 1 means the dense path is exact.
+    """
+    if seq_axis is None:
+        return None
+    from distributed_pytorch_example_tpu.runtime.mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None or seq_axis not in mesh.axis_names:
+        raise RuntimeError(
+            f"seq_axis={seq_axis!r} requires an active `with mesh:` "
+            "context whose mesh has that axis (Trainer enters it "
+            "automatically; wrap manual apply() calls yourself)."
+        )
+    if mesh.shape[seq_axis] <= 1:
+        return None
+    return mesh
 
 
 def _run_stacked(mod, params, x, block, aux_init=None):
@@ -141,12 +279,71 @@ def _run_stacked(mod, params, x, block, aux_init=None):
 
     result = gpipe(
         stage_fn, sp, x, mesh, n_micro, pipe_axis=mod.pipe_axis,
-        aux_init=aux_init,
+        aux_init=aux_init, seq_axis=getattr(mod, "seq_axis", None),
     )
     if aux_init is None:
         return result
     out, aux_sum = result
     return out, aux_sum, float(n_micro)
+
+
+def _run_stacked_1f1b(mod, params, x, last, block):
+    """1F1B train pass: loss computed per microbatch at the last stage.
+
+    ``last`` is ``(last_fn, last_params, last_args)`` from the parent model
+    (final norm + head + loss for ONE microbatch — see
+    parallel/pipeline.py one_f_one_b). Returns the primitive's
+    ``(loss_sum, metric_sums, aux_sums)``; normalize by ``n_micro``
+    outside. MoE stacks are not yet served here (GPipe remains the MoE
+    schedule); the parent models enforce that.
+    """
+    from distributed_pytorch_example_tpu.parallel.pipeline import one_f_one_b
+    from distributed_pytorch_example_tpu.runtime.mesh import (
+        current_mesh,
+        data_parallel_size,
+    )
+
+    x = x.astype(mod.dtype)
+    if mod.remat:
+        block = jax.checkpoint(block, prevent_cse=False)
+    pipe = _pipe_size(mod.pipe_axis)
+    if pipe <= 1:
+        raise ValueError(
+            "pipe_schedule='1f1b' requires a pipe mesh axis of size >= 2 "
+            "(the schedule interleaves backward across stages); run "
+            "schedule='gpipe' or drop pipe_axis for single-device training"
+        )
+    if _sp_mesh(getattr(mod, "seq_axis", None)) is not None:
+        raise NotImplementedError(
+            "pipe_schedule='1f1b' does not compose with sequence "
+            "parallelism yet (the in-schedule loss would need "
+            "sequence-chunked CE); use the GPipe schedule for SP x PP"
+        )
+    mesh = current_mesh()
+    L = mod.num_layers
+    if L % pipe:
+        raise ValueError(f"num_layers {L} not divisible by pipe size {pipe}")
+    n_micro = mod.pipe_microbatches or _auto_microbatches(
+        x.shape[0], pipe, data_parallel_size(mesh)
+    )
+    sp = jax.tree_util.tree_map(
+        lambda v: v.reshape(pipe, L // pipe, *v.shape[1:]), params
+    )
+
+    def stage_fn(stage_params, h):
+        def body(hh, lp):
+            return block(lp, hh), None
+
+        out, _ = lax.scan(body, h, stage_params)
+        return out
+
+    last_fn, last_params, last_args = last
+    loss_sum, mets, aux = one_f_one_b(
+        stage_fn, sp, x, mesh, n_micro,
+        last_fn=last_fn, last_params=last_params, last_args=last_args,
+        pipe_axis=mod.pipe_axis,
+    )
+    return loss_sum, mets, aux, n_micro
 
 
 def _run_moe_stacked(mod, params, x, block):
@@ -205,6 +402,8 @@ class StackedDecoder(nn.Module):
     remat: bool = False
     pipe_axis: Optional[str] = None  # mesh axis for pipeline stages
     pipe_microbatches: int = 0  # 0 = auto (largest k*pipe <= 4*pipe | batch)
+    seq_axis: Optional[str] = None  # SP inside the stages (SP x PP)
+    sp_mode: str = "ring"  # "ring" | "ulysses"
     moe_experts: int = 0  # >0: MoE MLP on EVERY block (gelu experts)
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
@@ -212,7 +411,7 @@ class StackedDecoder(nn.Module):
     moe_z_loss_weight: float = 1e-3
 
     @nn.compact
-    def __call__(self, x, *, train: bool = False):
+    def __call__(self, x, *, train: bool = False, last=None):
         L, D, M = self.num_layers, self.model_dim, self.mlp_dim
         F = self.num_heads * self.head_dim
         E = self.moe_experts
@@ -252,6 +451,11 @@ class StackedDecoder(nn.Module):
                 ),
                 "moe_down_bias": stacked("moe_down_bias", zeros, (E, D)),
             })
+            if last is not None:
+                raise ValueError(
+                    "pipe_schedule='1f1b' does not serve MoE stacks yet; "
+                    "use the GPipe schedule for MoE pipelines"
+                )
             return self._run_moe(params, x)
         params.update({
             "up_kernel": stacked("up_kernel", lecun, (D, M)),
@@ -259,6 +463,10 @@ class StackedDecoder(nn.Module):
             "down_kernel": stacked("down_kernel", lecun, (M, D)),
             "down_bias": stacked("down_bias", zeros, (D,)),
         })
+        if last is not None:
+            return _run_stacked_1f1b(
+                self, params, x, last, self._block_fn(x.shape)
+            )
         return _run_stacked(self, params, x, self._block_fn(x.shape))
 
     def _run_moe(self, params, x):
@@ -299,24 +507,29 @@ class StackedDecoder(nn.Module):
 
     def _attn_fn(self, x_shape):
         """(layer_params, h) -> h after the pre-LN attention residual."""
-        seq = x_shape[1]
         dtype = self.dtype
         eps = self.layer_norm_epsilon
-        heads_shape = (-1, seq, self.num_heads, self.head_dim)
         scale = 1.0 / math.sqrt(self.head_dim)
+        # SP x PP: inside the pipeline shard_map (manual over {pipe, seq})
+        # the stage sees sequence-local chunks — dispatch chunk-local SP
+        # collectives; shapes come from the runtime activation, not the
+        # global x_shape
+        sp_local = (
+            _sp_mesh(self.seq_axis) is not None
+            and _pipe_size(self.pipe_axis) > 1
+        )
+        nh, hd = self.num_heads, self.head_dim
 
         def dense(z, kernel, bias):
             return z @ kernel.astype(dtype) + bias.astype(dtype)
 
         def attn_part(lp, h):
             a = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"], eps, dtype)
-            q = dense(a, lp["q_kernel"], lp["q_bias"]).reshape(heads_shape)
-            k = dense(a, lp["k_kernel"], lp["k_bias"]).reshape(heads_shape)
-            v = dense(a, lp["v_kernel"], lp["v_bias"]).reshape(heads_shape)
-            attn = dot_product_attention(
-                q, k, v, causal=self.causal, softmax_scale=scale,
-                use_flash=self.use_flash,
-            )
+            shp = (-1, a.shape[1], nh, hd)
+            q = dense(a, lp["q_kernel"], lp["q_bias"]).reshape(shp)
+            k = dense(a, lp["k_kernel"], lp["k_bias"]).reshape(shp)
+            v = dense(a, lp["v_kernel"], lp["v_bias"]).reshape(shp)
+            attn = _sp_attention(self, q, k, v, scale, self.causal, sp_local)
             attn = attn.reshape(*h.shape[:-1], -1)
             return h + dense(attn, lp["o_kernel"], lp["o_bias"])
 
@@ -367,6 +580,8 @@ class StackedLlamaDecoder(nn.Module):
     remat: bool = False
     pipe_axis: Optional[str] = None
     pipe_microbatches: int = 0
+    seq_axis: Optional[str] = None  # SP inside the stages (SP x PP)
+    sp_mode: str = "ulysses"  # "ring" | "ulysses" (llama family default)
     moe_experts: int = 0  # >0: Mixtral-style SwiGLU-expert MoE, EVERY block
     moe_top_k: int = 2  # Mixtral default
     moe_capacity_factor: float = 1.25
@@ -374,7 +589,7 @@ class StackedLlamaDecoder(nn.Module):
     moe_z_loss_weight: float = 1e-3
 
     @nn.compact
-    def __call__(self, x, *, train: bool = False):
+    def __call__(self, x, *, train: bool = False, last=None):
         if self.num_heads % self.num_kv_heads:
             raise ValueError(
                 f"num_heads {self.num_heads} not divisible by num_kv_heads "
@@ -419,6 +634,11 @@ class StackedLlamaDecoder(nn.Module):
                     "moe_down_kernel", lecun_e, (E, M, D)
                 ),
             })
+            if last is not None:
+                raise ValueError(
+                    "pipe_schedule='1f1b' does not serve MoE stacks yet; "
+                    "use the GPipe schedule for MoE pipelines"
+                )
             return _run_moe_stacked(
                 self, params, x, self._moe_block_fn(x.shape)
             )
@@ -427,34 +647,48 @@ class StackedLlamaDecoder(nn.Module):
             "up_kernel": stacked("up_kernel", lecun, (D, M)),
             "down_kernel": stacked("down_kernel", lecun, (M, D)),
         })
+        if last is not None:
+            return _run_stacked_1f1b(
+                self, params, x, last, self._block_fn(x.shape)
+            )
         return _run_stacked(self, params, x, self._block_fn(x.shape))
 
     def _attn_fn(self, x_shape):
         """(layer_params, h) -> h after the RoPE/GQA attention residual."""
         from distributed_pytorch_example_tpu.ops.rope import rope
 
-        seq = x_shape[1]
         dtype = self.dtype
         eps = self.layer_norm_epsilon
-        q_shape = (-1, seq, self.num_heads, self.head_dim)
-        kv_shape = (-1, seq, self.num_kv_heads, self.head_dim)
         scale = 1.0 / math.sqrt(self.head_dim)
         theta = self.rope_theta
+        nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        sp_local = (
+            _sp_mesh(self.seq_axis) is not None
+            and _pipe_size(self.pipe_axis) > 1
+        )
+        seq_axis = self.seq_axis
 
         def dense(z, kernel):
             return z @ kernel.astype(dtype)
 
         def attn_part(lp, h):
             a = _rms_norm(h, lp["ln1_scale"], eps, dtype)
-            q = dense(a, lp["q_kernel"]).reshape(q_shape)
-            k = dense(a, lp["k_kernel"]).reshape(kv_shape)
-            v = dense(a, lp["v_kernel"]).reshape(kv_shape)
-            q = rope(q, theta=theta)
-            k = rope(k, theta=theta)
-            attn = dot_product_attention(
-                q, k, v, causal=True, softmax_scale=scale,
-                use_flash=self.use_flash,
-            )
+            s_loc = a.shape[1]
+            q = dense(a, lp["q_kernel"]).reshape(-1, s_loc, nh, hd)
+            k = dense(a, lp["k_kernel"]).reshape(-1, s_loc, nkv, hd)
+            v = dense(a, lp["v_kernel"]).reshape(-1, s_loc, nkv, hd)
+            if sp_local:
+                # sequence-local chunk: RoPE needs the GLOBAL positions of
+                # this shard (models/transformer.py applies rope pre-shard
+                # for the same reason)
+                positions = lax.axis_index(seq_axis) * s_loc + jnp.arange(
+                    s_loc
+                )
+            else:
+                positions = None
+            q = rope(q, positions=positions, theta=theta)
+            k = rope(k, positions=positions, theta=theta)
+            attn = _sp_attention(self, q, k, v, scale, True, sp_local)
             return h + dense(attn.reshape(*h.shape[:-1], -1), lp["o_kernel"])
 
         return attn_part

@@ -39,7 +39,7 @@ stage function must preserve activation shape.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -201,6 +201,7 @@ def gpipe(
     pipe_axis: str = "pipe",
     batch_axes: Sequence[str] = ("data", "fsdp"),
     aux_init: Any = None,
+    seq_axis: Optional[str] = None,
 ) -> jax.Array:
     """Run ``x`` through ``n_stages`` pipelined stages of ``stage_fn``.
 
@@ -221,6 +222,14 @@ def gpipe(
         losses). Bubble-tick garbage is excluded; the returned aux is the
         SUM over every (stage layer, microbatch) contribution — divide by
         ``n_micro`` for per-batch means.
+      seq_axis: SP x PP composition — when the mesh spans this axis, the
+        schedule's shard_map goes manual over {pipe, seq} and ``stage_fn``
+        receives SEQUENCE-LOCAL activation chunks (dim 2 sharded over
+        ``seq_axis``); its attention must then run the chunk-local SP
+        collectives (ring/Ulysses with ``axis_name=seq_axis``) itself.
+        One flat manual region, no nested shard_map — differentiating
+        through nested shard_maps whose bodies hold custom VJPs mis-builds
+        residual shardings (duplicate-axis PartitionSpecs).
 
     Returns activations of the final stage, same shape as ``x``; with
     ``aux_init``, the tuple ``(activations, aux_totals)``.
@@ -233,13 +242,28 @@ def gpipe(
         raise ValueError(
             f"n_micro {n_micro} not divisible by pipe size {n_stages}"
         )
+    seq = seq_axis if (seq_axis and mesh.shape.get(seq_axis, 1) > 1) else None
+    if seq is not None and x.ndim < 3:
+        raise ValueError(
+            f"seq_axis={seq!r} needs (batch, seq, ...) activations, got "
+            f"rank {x.ndim}"
+        )
+    if seq is not None and aux_init is not None:
+        raise NotImplementedError(
+            "aux accumulation (MoE) does not compose with seq_axis inside "
+            "the pipeline; drop one (the models reject PP x SP x EP)"
+        )
     x_stack = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
     # the microbatch queue lives sharded over the pipe axis (dim 0); the
-    # per-microbatch batch dim keeps the usual data sharding (dim 1)
+    # per-microbatch batch dim keeps the usual data sharding (dim 1), and
+    # under SP x PP the sequence dim (dim 2) is manual over seq_axis
     data = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    # two specs: the GSPMD constraint may mention auto axes (data), the
+    # shard_map specs may only mention the MANUAL axes (pipe, seq)
+    queue_spec = P(pipe_axis, data or None, seq)
+    smap_spec = P(pipe_axis) if seq is None else P(pipe_axis, None, seq)
     x_stack = lax.with_sharding_constraint(
-        x_stack,
-        NamedSharding(mesh, P(pipe_axis, data or None)),
+        x_stack, NamedSharding(mesh, queue_spec)
     )
 
     fn = jax.shard_map(
@@ -250,24 +274,470 @@ def gpipe(
         mesh=mesh,
         in_specs=(
             jax.tree_util.tree_map(lambda _: P(pipe_axis), stage_params),
-            P(pipe_axis),
+            smap_spec,
         ),
         # aux is psum'd over the pipe axis inside: replicated on the way out
-        out_specs=P(pipe_axis) if aux_init is None else (
-            P(pipe_axis),
+        out_specs=smap_spec if aux_init is None else (
+            smap_spec,
             jax.tree_util.tree_map(lambda _: P(), aux_init),
         ),
-        axis_names={pipe_axis},
+        axis_names={pipe_axis} | ({seq} if seq else set()),
     )
+
+    # pin the output queue to the input queue's spec: without this, GSPMD
+    # may propagate a downstream consumer's compound batch sharding onto
+    # the microbatch dim, which collides with the pipe-sharded dim 0
+    # inside the schedule's scan
+    def pin(o):
+        return lax.with_sharding_constraint(
+            o, NamedSharding(mesh, queue_spec)
+        )
+
     if aux_init is None:
-        out = fn(stage_params, x_stack)
+        out = pin(fn(stage_params, x_stack))
         return out.reshape(x.shape)
     out, aux = fn(stage_params, x_stack)
-    return out.reshape(x.shape), aux
+    return pin(out).reshape(x.shape), aux
 
 
 def stack_stage_params(per_stage_params: Sequence[Any]) -> Any:
     """Stack per-stage param pytrees into the leading-stage-dim layout."""
     return jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves), *per_stage_params
+    )
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (one-forward-one-backward) schedule
+# ---------------------------------------------------------------------------
+#
+# GPipe above runs ALL forwards, then differentiates the scan in reverse —
+# so every tick's stage internals are saved as autodiff residuals and peak
+# activation memory grows with n_micro while the bubble only shrinks with
+# it. 1F1B (PipeDream-flush / Megatron-LM's production schedule) interleaves
+# each microbatch's backward as soon as its forward reaches the last stage,
+# which bounds in-flight activations at ~n_stages microbatches REGARDLESS of
+# n_micro. The price of interleaving: the loss must be computable per
+# microbatch INSIDE the schedule (the last stage needs the loss gradient of
+# microbatch u in the same cycle it finishes u's forward), so this entry
+# point takes the model tail — final norm + head + loss — as ``last_fn``
+# instead of returning activations for an outer loss.
+#
+# Lockstep SPMD formulation: one ``lax.scan`` over cycles inside a
+# shard_map manual on the pipe axis; in cycle c every stage s runs
+#
+#   F sub-tick:  forward  of microbatch u_F = c - s
+#   B sub-tick:  backward of microbatch u_B = c - 2(S-1) + s
+#
+# (both predicated on 0 <= u < n_micro; inactive sub-ticks compute garbage
+# that is never stored — the usual SPMD pipeline deal). At the last stage
+# u_F == u_B: its F computes per-microbatch loss + dL/dy via
+# ``jax.value_and_grad`` over ``last_fn`` and its B consumes that seed in
+# the same cycle — this is what makes the schedule 1F1B rather than
+# all-F-then-all-B. Backwards run as per-microbatch ``jax.vjp`` with stage
+# RECOMPUTE from a stashed stage input (Megatron's selective recompute):
+# the only thing a stage keeps per in-flight microbatch is its INPUT, in a
+# ring of ``2(S-1)+1`` slots — peak stash is independent of n_micro, the
+# ~n_micro -> ~n_stages drop measured in scripts/pipeline_memory.py.
+#
+# Communication per cycle (all neighbor ICI): activations ppermute up,
+# cotangents ppermute down, the input queue rotates toward stage 0 (as in
+# GPipe), and finished dx microbatches ride a delivery ring up from stage 0
+# so dL/dx leaves sharded over pipe exactly like the input queue came in.
+#
+# Wall-clock honesty: a cycle costs one forward plus one
+# backward-with-recompute (~3 forward units), and there are
+# n_micro + 3(S-1) cycles, so at small n_micro this schedule is SLOWER than
+# GPipe-without-remat (which pays ~3 units x (n_micro + S - 1) ticks); it
+# matches GPipe-with-remat asymptotically and wins on what it is for:
+# activation memory, the binding constraint at depth x sequence scale.
+# Every stage also traces ``last_fn`` (SPMD — only the last stage's result
+# is kept), so keep the head cost in mind when S is large.
+#
+# Differentiation contract: ``one_f_one_b`` is wrapped in jax.custom_vjp
+# whose FORWARD pass runs the schedule and computes the parameter/input
+# gradients eagerly (that is the point of 1F1B); the residuals ARE the
+# gradients, and the backward pass just scales them by the incoming loss
+# cotangent. Consequently the aux-loss outputs (MoE balancing losses) are
+# REPORTING-ONLY values: their gradient contribution is seeded inside the
+# schedule via ``aux_weights`` (the fixed coefficients the trainer would
+# multiply them by), and cotangents arriving on the aux/metric outputs are
+# ignored — do not scale aux losses outside by anything but their declared
+# weights.
+
+
+def one_f_one_b_cycles(n_micro: int, n_stages: int) -> int:
+    """Total schedule cycles: m forwards + warmup/drain + dx-ring tail."""
+    return n_micro + 3 * (n_stages - 1)
+
+
+def one_f_one_b_stash_slots(n_stages: int) -> int:
+    """Stage-input stash ring size: the in-flight window ``u_F - u_B`` is
+    ``2(S-1-s)`` at stage s, maximal at stage 0 — one live slot more."""
+    return 2 * (n_stages - 1) + 1
+
+
+def one_f_one_b_bubble(n_micro: int, n_stages: int) -> float:
+    """Fraction of cycles that are fill/drain bubble (per sub-tick)."""
+    return 1.0 - n_micro / one_f_one_b_cycles(n_micro, n_stages)
+
+
+def _tree_where(pred, a, b):
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.where(pred, x, y), a, b
+    )
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def _zeros_of(struct):
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), struct
+    )
+
+
+def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
+                stage_fn: StageFn, last_fn, axis_name: str, n_micro: int,
+                aux_desc):
+    """Per-device 1F1B program; call under shard_map (manual on pipe).
+
+    in_buf: (m_s, microbatch, ...) — this stage's shard of the input queue
+    (same layout/rotation as the GPipe queue: stage 0's head holds
+    microbatch c at cycle c). last_args: (n_micro, ...) per-microbatch
+    arguments for ``last_fn`` (e.g. target tokens), replicated over pipe.
+
+    Returns (loss_sum, metric_sums, aux_sums, d_stage(1, ...), d_last,
+    dx_buf) — loss/metrics/aux psum'd over pipe; d_stage/dx stay sharded.
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    is_last = stage == n_stages - 1
+    is_first = stage == 0
+    m_s = in_buf.shape[0]
+    K = one_f_one_b_stash_slots(n_stages)
+    n_cycles = one_f_one_b_cycles(n_micro, n_stages)
+    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    # last_params arrive pipe-UNVARYING (replicated); differentiating a
+    # varying loss wrt an unvarying value makes the transpose psum the
+    # cotangent over pipe — which would fold other stages' masked-out
+    # garbage evaluations into every dlast_u. Stamp them varying so grads
+    # stay per-device until the explicit masked psum at the end.
+    last_params = pvary_like(last_params, in_buf, (axis_name,))
+
+    if aux_desc is None:
+        aux_zero = aux_weights = None
+    else:
+        treedef, weights = aux_desc
+        leaves = [jnp.float32(w) for w in weights]
+        aux_weights = jax.tree_util.tree_unflatten(treedef, leaves)
+        aux_zero = pvary_like(
+            jax.tree_util.tree_map(jnp.zeros_like, aux_weights), in_buf,
+            (axis_name,),
+        )
+
+    shift_up = [(i, i + 1) for i in range(n_stages - 1)]  # activations
+    shift_down = [(i + 1, i) for i in range(n_stages - 1)]  # cotangents
+    ring_down = [(i, (i - 1) % n_stages) for i in range(n_stages)]  # queue
+    ring_up = [(i, (i + 1) % n_stages) for i in range(n_stages)]  # dx out
+
+    mb_shape, mb_dtype = in_buf.shape[1:], in_buf.dtype
+
+    def slice_args(u):
+        cu = jnp.clip(u, 0, n_micro - 1)
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, cu, 0, keepdims=False),
+            last_args,
+        )
+
+    def last_loss(y, lp, a):
+        return last_fn(lp, y, a)
+
+    # metric accumulator structure, discovered abstractly
+    y_proto = jax.ShapeDtypeStruct(mb_shape, mb_dtype)
+    _, mets_struct = jax.eval_shape(
+        last_loss, y_proto, last_params, slice_args(jnp.int32(0))
+    )
+
+    def cycle(carry, c):
+        (incoming, cot_in, in_buf, stash, dx_buf, reg_dx, reg_du,
+         d_stage, d_last, loss_acc, mets_acc, aux_acc) = carry
+
+        # ---- F sub-tick: forward microbatch u_f ----
+        u_f = c - stage
+        active_f = (u_f >= 0) & (u_f < n_micro)
+        head_slot = c % m_s
+        head = lax.dynamic_index_in_dim(in_buf, head_slot, 0, keepdims=False)
+        x_in = jnp.where(is_first, head, incoming)
+        stash = _store(stash, x_in, u_f % K, active_f)
+        if aux_desc is None:
+            y = stage_fn(params, x_in)
+        else:
+            y, aux_tick = stage_fn(params, x_in)
+            aux_acc = _tree_add(
+                aux_acc, _tree_where(active_f, aux_tick, aux_zero)
+            )
+
+        # last stage: per-microbatch loss, metrics, and the backward seed
+        (loss_u, mets_u), (dy_u, dlast_u) = jax.value_and_grad(
+            last_loss, argnums=(0, 1), has_aux=True
+        )(y, last_params, slice_args(u_f))
+        keep = is_last & active_f
+        loss_acc = loss_acc + jnp.where(keep, loss_u, 0.0)
+        mets_acc = _tree_add(
+            mets_acc, _tree_where(keep, mets_u, _zeros_of(mets_struct))
+        )
+        d_last = _tree_add(
+            d_last,
+            _tree_where(
+                keep, dlast_u,
+                jax.tree_util.tree_map(jnp.zeros_like, dlast_u),
+            ),
+        )
+
+        # ---- B sub-tick: backward microbatch u_b (recompute from stash) --
+        u_b = c - 2 * (n_stages - 1) + stage
+        active_b = (u_b >= 0) & (u_b < n_micro)
+        x_saved = lax.dynamic_index_in_dim(
+            stash, jnp.clip(u_b, 0, n_micro - 1) % K, 0, keepdims=False
+        )
+        cot = jnp.where(is_last, dy_u, cot_in)
+        if aux_desc is None:
+            _, vjp_fn = jax.vjp(stage_fn, params, x_saved)
+            dparams_u, dx_u = vjp_fn(cot)
+        else:
+            (_, aux_primal), vjp_fn = jax.vjp(stage_fn, params, x_saved)
+            # each weight seed must carry exactly its aux output's
+            # varying-manual-axes type (a constant aux stays unvarying)
+            aux_ct = jax.tree_util.tree_map(
+                lambda w, a: pvary_like(w, a, ()), aux_weights, aux_primal
+            )
+            dparams_u, dx_u = vjp_fn((cot, aux_ct))
+        d_stage = _tree_add(
+            d_stage,
+            _tree_where(
+                active_b, dparams_u,
+                jax.tree_util.tree_map(jnp.zeros_like, dparams_u),
+            ),
+        )
+
+        # stage 0's dx is final: self-store its own block, ring the rest up
+        dx_final = is_first & active_b
+        dx_buf = _store(dx_buf, dx_u, u_b % m_s, dx_final & (u_b // m_s == 0))
+        send_dx = jnp.where(is_first, dx_u, reg_dx)
+        send_du = jnp.where(
+            is_first, jnp.where(active_b, u_b, -1), reg_du
+        )
+        reg_dx = lax.ppermute(send_dx, axis_name, ring_up)
+        reg_du = lax.ppermute(send_du, axis_name, ring_up)
+        dx_buf = _store(
+            dx_buf, reg_dx, reg_du % m_s,
+            (reg_du >= 0) & (reg_du // m_s == stage) & ~is_first,
+        )
+
+        # ---- neighbor comms for the next cycle ----
+        if n_stages > 1:
+            incoming = lax.ppermute(y, axis_name, shift_up)
+            cot_in = lax.ppermute(dx_u, axis_name, shift_down)
+        received = lax.ppermute(head, axis_name, ring_down)
+        in_buf = lax.dynamic_update_index_in_dim(
+            in_buf, received, head_slot, 0
+        )
+        return (incoming, cot_in, in_buf, stash, dx_buf, reg_dx, reg_du,
+                d_stage, d_last, loss_acc, mets_acc, aux_acc), None
+
+    def pv(x):
+        return pvary_like(x, in_buf, (axis_name,))
+
+    carry0 = (
+        pv(jnp.zeros(mb_shape, mb_dtype)),          # incoming activation
+        pv(jnp.zeros(mb_shape, mb_dtype)),          # incoming cotangent
+        in_buf,
+        pv(jnp.zeros((K, *mb_shape), mb_dtype)),    # input stash ring
+        pv(jnp.zeros_like(in_buf)),                 # dx out queue
+        pv(jnp.zeros(mb_shape, mb_dtype)),          # dx ring register
+        pv(jnp.full((), -1, jnp.int32)),            # dx ring mb index
+        pv(jax.tree_util.tree_map(jnp.zeros_like, params)),      # d_stage
+        pv(jax.tree_util.tree_map(jnp.zeros_like, last_params)),  # d_last
+        pv(jnp.zeros((), jnp.float32)),             # loss sum
+        pv(_zeros_of(mets_struct)),                 # metric sums
+        pv(aux_zero) if aux_desc is not None else None,
+    )
+    (_, _, _, _, dx_buf, _, _, d_stage, d_last, loss_acc, mets_acc,
+     aux_acc) = lax.scan(cycle, carry0, jnp.arange(n_cycles))[0]
+
+    psum = lambda t: jax.tree_util.tree_map(
+        lambda a: lax.psum(a, axis_name), t
+    )
+    aux_out = psum(aux_acc) if aux_desc is not None else {}
+    return (
+        psum(loss_acc), psum(mets_acc), aux_out,
+        jax.tree_util.tree_map(lambda g: g[None], d_stage),
+        psum(d_last), dx_buf,
+    )
+
+
+def _1f1b_run(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
+              aux_desc, stage_params, last_params, x_stack, last_args):
+    """Trace the 1F1B shard_map; returns outputs AND gradients."""
+    mets_struct = jax.eval_shape(
+        lambda lp, y, a: last_fn(lp, y, a)[1],
+        last_params,
+        jax.ShapeDtypeStruct(x_stack.shape[1:], x_stack.dtype),
+        jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), last_args
+        ),
+    )
+    aux_struct = (
+        aux_desc[0].unflatten(list(aux_desc[1]))
+        if aux_desc is not None else {}
+    )
+    fn = jax.shard_map(
+        functools.partial(
+            _1f1b_local, stage_fn=stage_fn, last_fn=last_fn,
+            axis_name=pipe_axis, n_micro=n_micro, aux_desc=aux_desc,
+        ),
+        mesh=mesh,
+        in_specs=(
+            jax.tree_util.tree_map(lambda _: P(pipe_axis), stage_params),
+            jax.tree_util.tree_map(lambda _: P(), last_params),
+            P(pipe_axis),
+            jax.tree_util.tree_map(lambda _: P(), last_args),
+        ),
+        out_specs=(
+            P(),
+            jax.tree_util.tree_map(lambda _: P(), mets_struct),
+            jax.tree_util.tree_map(lambda _: P(), aux_struct),
+            jax.tree_util.tree_map(lambda _: P(pipe_axis), stage_params),
+            jax.tree_util.tree_map(lambda _: P(), last_params),
+            P(pipe_axis),
+        ),
+        axis_names={pipe_axis},
+    )
+    return fn(stage_params, last_params, x_stack, last_args)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6))
+def _1f1b_loss(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
+               aux_desc, stage_params, last_params, x_stack, last_args):
+    loss, mets, aux, _, _, _ = _1f1b_run(
+        stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes, aux_desc,
+        stage_params, last_params, x_stack, last_args,
+    )
+    return loss, mets, aux
+
+
+def _1f1b_loss_fwd(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
+                   aux_desc, stage_params, last_params, x_stack, last_args):
+    loss, mets, aux, d_stage, d_last, dx = _1f1b_run(
+        stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes, aux_desc,
+        stage_params, last_params, x_stack, last_args,
+    )
+    int_args = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), last_args
+    )
+    return (loss, mets, aux), (d_stage, d_last, dx, int_args)
+
+
+def _1f1b_loss_bwd(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
+                   aux_desc, res, cts):
+    import numpy as np
+
+    d_stage, d_last, dx, int_args = res
+    ct_loss = cts[0]  # aux/metric cotangents are ignored by contract
+
+    def scale(t):
+        return jax.tree_util.tree_map(lambda g: g * ct_loss, t)
+
+    # non-differentiable (int/bool) leaves take float0 cotangents
+    zeros_args = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype)
+        if jnp.issubdtype(s.dtype, jnp.inexact)
+        else np.zeros(s.shape, jax.dtypes.float0),
+        int_args,
+    )
+    return scale(d_stage), scale(d_last), scale(dx), zeros_args
+
+
+_1f1b_loss.defvjp(_1f1b_loss_fwd, _1f1b_loss_bwd)
+
+
+def one_f_one_b(
+    stage_fn: StageFn,
+    stage_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    n_micro: int,
+    *,
+    last_fn,
+    last_params: Any,
+    last_args: Any,
+    pipe_axis: str = "pipe",
+    batch_axes: Sequence[str] = ("data", "fsdp"),
+    aux_weights: Any = None,
+) -> tuple:
+    """1F1B pipeline train pass: per-microbatch loss computed at the last
+    stage, backward interleaved one cycle behind forward.
+
+    Args:
+      stage_fn: ``(stage_param_slice, activation) -> activation`` (or
+        ``(activation, aux)`` with ``aux_weights``); shape-preserving.
+      stage_params: stacked (n_stages, ...) pytree sharded over
+        ``pipe_axis``.
+      x: global input activations (batch, ...), split into ``n_micro``
+        microbatches on the leading dim.
+      last_fn: ``(last_params, y_mb, args_mb) -> (loss, metrics)`` — the
+        model tail (final norm, head, loss) applied to one microbatch's
+        final activations at the LAST stage. ``loss`` must be a scalar;
+        ``metrics`` a pytree of scalars. Sums over microbatches are
+        returned — normalize by ``n_micro`` (or token counts) outside.
+      last_params: pytree of tail parameters (replicated over pipe;
+        gradients are returned through the custom VJP).
+      last_args: pytree of per-microbatch arrays stacked on a leading
+        ``n_micro`` dim (e.g. target tokens), replicated over pipe.
+        Integer/bool leaves get float0 cotangents (non-differentiable).
+      aux_weights: optional pytree of PYTHON FLOAT coefficients matching
+        the aux structure ``stage_fn`` emits; they seed the aux cotangents
+        inside the schedule (see module comment — aux outputs are
+        reporting-only). Normalization contract: the gradients delivered
+        through the custom VJP are ``d(loss_sum + sum_k w_k * aux_sum_k)``
+        scaled by the cotangent arriving on ``loss_sum`` — so an outer
+        objective of ``(loss_sum + sum_k w_k * aux_sum_k) / n_micro``
+        (mean loss + weighted mean aux, the trainer's convention) gets
+        exactly the right gradients, while any OTHER outer scaling of the
+        aux terms is silently ignored.
+
+    Returns ``(loss_sum, metric_sums, aux_sums)``, differentiable wrt
+    (stage_params, last_params, x).
+    """
+    batch = x.shape[0]
+    n_stages = mesh.shape[pipe_axis]
+    if batch % n_micro:
+        raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
+    if n_micro % n_stages:
+        raise ValueError(
+            f"n_micro {n_micro} not divisible by pipe size {n_stages}"
+        )
+    x_stack = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
+    data = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    x_stack = lax.with_sharding_constraint(
+        x_stack, NamedSharding(mesh, P(pipe_axis, data or None))
+    )
+    mb = batch // n_micro
+    last_args = jax.tree_util.tree_map(
+        lambda a: a.reshape(n_micro, mb, *a.shape[1:])
+        if a.shape[:1] == (batch,) else a,
+        last_args,
+    )
+    if aux_weights is None:
+        aux_desc = None
+    else:
+        leaves, treedef = jax.tree_util.tree_flatten(aux_weights)
+        if not all(isinstance(w, (int, float)) for w in leaves):
+            raise TypeError("aux_weights must be python floats (static)")
+        aux_desc = (treedef, tuple(float(w) for w in leaves))
+    return _1f1b_loss(
+        stage_fn, last_fn, mesh, n_micro, pipe_axis, data, aux_desc,
+        stage_params, last_params, x_stack, last_args,
     )

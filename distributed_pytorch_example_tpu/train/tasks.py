@@ -128,6 +128,10 @@ class CausalLMTask:
         self, model, params, model_state, batch, rng, *, train: bool
     ) -> Tuple[jax.Array, Metrics, Any]:
         tokens = batch["tokens"]
+        if train and getattr(model, "pipe_schedule", "gpipe") == "1f1b":
+            return self._pipelined_1f1b(
+                model, params, model_state, tokens, rng
+            )
         out, new_ms, aux, extra = _apply_model(
             model, params, model_state, tokens, rng, train
         )
@@ -150,6 +154,28 @@ class CausalLMTask:
         ).mean() + aux
         accuracy = 100.0 * jnp.mean(jnp.argmax(logits, axis=-1) == targets)
         return loss, {"loss": loss, "accuracy": accuracy, **extra}, new_ms
+
+    def _pipelined_1f1b(self, model, params, model_state, tokens, rng):
+        """Train step for ``pipe_schedule='1f1b'`` models: the loss runs
+        INSIDE the pipeline schedule (the last stage needs each
+        microbatch's loss gradient the cycle it finishes its forward —
+        parallel/pipeline.py), so the model is applied with ``targets``
+        and returns ``(mean loss, {'correct': count})`` instead of
+        activations. Metric semantics match the outer-loss path: mean
+        next-token loss, accuracy over all target positions."""
+        variables = {"params": params, **(model_state or {})}
+        (loss, mets), new_vars = model.apply(
+            variables, tokens, train=True, targets=tokens,
+            rngs={"dropout": rng},
+            mutable=list(model_state.keys()) if model_state else [],
+        )
+        n_targets = tokens.shape[0] * (tokens.shape[1] - 1)
+        accuracy = 100.0 * mets["correct"] / n_targets
+        return (
+            loss,
+            {"loss": loss, "accuracy": accuracy},
+            dict(new_vars) or (model_state or {}),
+        )
 
 
 class MLMTask:

