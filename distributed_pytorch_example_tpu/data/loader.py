@@ -106,12 +106,14 @@ class DeviceLoader:
     def __len__(self) -> int:
         return self.steps_per_epoch
 
-    def _host_batches(self) -> Iterator[Dict[str, np.ndarray]]:
+    def _host_batches(
+        self, start_step: int = 0
+    ) -> Iterator[Dict[str, np.ndarray]]:
         indices = self.sampler.shard_indices()
         n = self.steps_per_epoch * self.local_batch_size
         if n > len(indices):  # wrap-pad the final partial batch
             indices = np.concatenate([indices, indices[: n - len(indices)]])
-        for step in range(self.steps_per_epoch):
+        for step in range(start_step, self.steps_per_epoch):
             lo = step * self.local_batch_size
             yield _get_batch(self.dataset, indices[lo : lo + self.local_batch_size])
 
@@ -126,8 +128,22 @@ class DeviceLoader:
         return {k: jax.device_put(v) for k, v in host_batch.items()}
 
     def __iter__(self) -> Iterator[Dict[str, Any]]:
+        return self.iter_from(0)
+
+    def iter_from(self, start_step: int) -> Iterator[Dict[str, Any]]:
+        """Iterate this epoch's batches from ``start_step`` onward.
+
+        Step-level resume support: the sampler's permutation is a pure
+        function of (seed, epoch), so skipping the first ``start_step``
+        batches reproduces EXACTLY the batches an uninterrupted run would
+        have seen — skipped batches are never assembled or transferred.
+        """
+        if not 0 <= start_step <= self.steps_per_epoch:
+            raise ValueError(
+                f"start_step {start_step} outside [0, {self.steps_per_epoch}]"
+            )
         if self.prefetch <= 0:
-            for hb in self._host_batches():
+            for hb in self._host_batches(start_step):
                 yield self._to_device(hb)
             return
 
@@ -137,7 +153,7 @@ class DeviceLoader:
 
         def producer():
             try:
-                for hb in self._host_batches():
+                for hb in self._host_batches(start_step):
                     q.put(self._to_device(hb))
             except BaseException as e:  # surfaced in the consumer
                 err.append(e)

@@ -23,5 +23,8 @@ from distributed_pytorch_example_tpu.train.checkpoint import (  # noqa: F401
     load_checkpoint,
     save_checkpoint,
 )
-from distributed_pytorch_example_tpu.train.loop import Trainer  # noqa: F401
+from distributed_pytorch_example_tpu.train.loop import (  # noqa: F401
+    PreemptionInterrupt,
+    Trainer,
+)
 from distributed_pytorch_example_tpu.train.generate import generate  # noqa: F401

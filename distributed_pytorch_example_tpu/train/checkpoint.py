@@ -15,9 +15,16 @@ Parity contract (reference train.py:178-209, 252-308; SURVEY.md §3.4):
   killed after epoch 2 resumes at epoch 3. This is a deliberate deviation
   from the reference, which stamps the epoch it just finished and then
   RE-RUNS it on resume (reference train.py:185,209,257 — the saved epoch is
-  both "work done" and "start point", double-training one epoch). Step-level
-  state is in ``state.step``; epoch granularity is the loop contract. Pinned
+  both "work done" and "start point", double-training one epoch). Pinned
   by tests/test_train.py::test_resume_continues_after_finished_epoch.
+- STEP-level resume (beyond-reference, r5): with ``save_every_steps`` the
+  loop also writes ``latest`` mid-epoch, stamped with the CURRENT epoch
+  plus ``extra["batch_in_epoch"]`` (the loader cursor). On resume the
+  trainer skips to that exact batch; the sampler permutation is a pure
+  function of (seed, epoch) and the step rng folds ``state.rng`` with the
+  restored ``state.step``, so the loss trajectory is bit-identical to the
+  uninterrupted run (tests/test_step_resume.py kills a run with SIGKILL
+  mid-epoch and proves it).
 
 Two on-disk formats, both flax-msgpack (no torch, no pickle — portable and
 introspectable), auto-detected on load:
@@ -386,6 +393,12 @@ def save_checkpoint(
         )
     )
     if sharded:
+        # a still-draining PREVIOUS async write may target the same
+        # version dir (mid-epoch saves reuse _version(epoch)); it must
+        # land before the cleanup rmtree below, or the old writer crashes
+        # mid-write / stale shards leak into the new manifest
+        if saver is not None:
+            saver.wait()
         _begin_sharded_save(path, epoch)  # main thread: cleanup + barrier
     if saver is not None and (sharded or jax.process_count() == 1):
         # HBM-side copy: later donated train steps cannot invalidate it

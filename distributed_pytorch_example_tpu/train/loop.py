@@ -19,7 +19,9 @@ from __future__ import annotations
 
 import contextlib
 import os
+import signal
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -42,6 +44,16 @@ from distributed_pytorch_example_tpu.train.step import (
 logger = get_logger(__name__)
 
 
+class PreemptionInterrupt(BaseException):
+    """Raised inside ``fit`` after a SIGTERM-triggered checkpoint landed.
+
+    BaseException so blanket ``except Exception`` recovery logic cannot
+    swallow a teardown. The CLI (train.py) converts it to ``exit(143)`` —
+    the rc the launcher treats as orchestrator teardown, NOT restarted
+    (launch/entrypoint.sh:133-141).
+    """
+
+
 class Trainer:
     """Binds (model, task, optimizer, partitioner) into a runnable job."""
 
@@ -58,6 +70,7 @@ class Trainer:
         profile_dir: Optional[str] = None,
         profile_window: tuple = (10, 13),
         checkpoint_format: str = "auto",
+        save_every_steps: int = 0,
     ):
         self.model = model
         self.task = task
@@ -84,6 +97,14 @@ class Trainer:
                 f"{checkpoint_format!r}"
             )
         self._checkpoint_format = checkpoint_format
+        # >0: write `latest` every N train batches WITH the loader cursor
+        # (epoch, batch_in_epoch) so resume restarts at the exact batch —
+        # step-level resume on top of the reference's epoch granularity
+        # (reference train.py:256-257; an epoch at long-context scale is
+        # too much to lose to a preemption)
+        self.save_every_steps = save_every_steps
+        self._best_accuracy = 0.0
+        self._preempt_requested = False
 
     def _sharded_ckpt(self) -> bool:
         """auto: sharded at multi-host scale (collective-free async saves,
@@ -124,11 +145,25 @@ class Trainer:
 
     # -- epochs -----------------------------------------------------------
 
-    def train_epoch(self, loader, epoch: int) -> Dict[str, float]:
+    def train_epoch(
+        self, loader, epoch: int, start_batch: int = 0
+    ) -> Dict[str, float]:
         loader.set_epoch(epoch)
         acc = MetricAccumulator()
         num_batches = len(loader)
-        for batch_idx, batch in enumerate(loader):
+        if start_batch:
+            # mid-epoch resume: the sampler's permutation is a pure
+            # function of (seed, epoch), so skipping reproduces exactly
+            # the uninterrupted run's remaining batches; this epoch's
+            # logged train metrics cover the post-resume batches only
+            logger.info(
+                "Resuming epoch %d at batch %d/%d",
+                epoch, start_batch, num_batches,
+            )
+            it = loader.iter_from(start_batch)
+        else:
+            it = iter(loader)
+        for batch_idx, batch in enumerate(it, start=start_batch):
             if self._profiler is not None:
                 self._profiler.step(self._global_step)
             with self._mesh_ctx():
@@ -143,7 +178,60 @@ class Trainer:
                     num_batches,
                     float(metrics["loss"]),
                 )
+            if (
+                self.save_every_steps
+                and self.checkpoint_dir
+                and (batch_idx + 1) % self.save_every_steps == 0
+                and batch_idx + 1 < num_batches  # epoch-end save follows
+            ):
+                self._save_mid_epoch(epoch, batch_idx, metrics)
+            if self._preempt_requested:
+                # graceful preemption (SIGTERM): the in-flight step has
+                # finished — write `latest` with the cursor, drain the
+                # saver, and unwind. The launcher still treats the exit as
+                # orchestrator teardown (rc 143, no restart); the NEXT
+                # launch resumes from this exact batch.
+                #
+                # Multi-process scope: signal delivery is NOT synchronized
+                # across hosts, so ranks may be at different steps — a save
+                # here would mix per-rank states (and its begin-save
+                # barrier would mismatch in-flight train-step collectives).
+                # Multi-process jobs get bounded loss from the
+                # DETERMINISTICALLY coordinated --save-every-steps saves
+                # (every rank saves at the same batch index) and exit
+                # cleanly here without an extra save.
+                if self.checkpoint_dir and jax.process_count() == 1:
+                    self._save_mid_epoch(epoch, batch_idx, metrics)
+                    self._saver.wait()
+                    logger.info(
+                        "Preemption checkpoint complete (epoch %d, batch "
+                        "%d)", epoch, batch_idx + 1,
+                    )
+                elif self.checkpoint_dir:
+                    logger.warning(
+                        "SIGTERM on a multi-process job: skipping the "
+                        "uncoordinated preemption save; latest periodic "
+                        "checkpoint (--save-every-steps) is the resume "
+                        "point"
+                    )
+                raise PreemptionInterrupt()
         return acc.result()
+
+    def _save_mid_epoch(self, epoch, batch_idx, metrics):
+        """Write `latest` stamped with the CURRENT epoch + loader cursor
+        (end-of-epoch saves stamp epoch+1 with no cursor)."""
+        ckpt_lib.save_checkpoint(
+            os.path.join(self.checkpoint_dir, ckpt_lib.LATEST_NAME),
+            self.state,
+            epoch,
+            float(metrics["loss"]),
+            {
+                "best_accuracy": self._best_accuracy,
+                "batch_in_epoch": batch_idx + 1,
+            },
+            saver=self._saver,
+            sharded=self._sharded_ckpt(),
+        )
 
     def validate(self, loader) -> Dict[str, float]:
         acc = MetricAccumulator()
@@ -192,6 +280,7 @@ class Trainer:
         )
 
         start_epoch = 0
+        start_batch = 0
         best_accuracy = 0.0
         if resuming:
             self.state, saved_epoch, extra = ckpt_lib.load_checkpoint(
@@ -199,18 +288,41 @@ class Trainer:
             )
             start_epoch = saved_epoch
             best_accuracy = float(extra.get("best_accuracy", 0.0))
+            # mid-epoch checkpoints (save_every_steps) carry the loader
+            # cursor; resume restarts at that exact batch
+            start_batch = int(extra.get("batch_in_epoch", 0))
+            if start_batch >= len(train_loader):
+                start_epoch, start_batch = start_epoch + 1, 0
         dist.barrier("pre-train")
 
         history: List[Dict[str, float]] = []
         start_time = time.time()
 
         self._global_step = 0  # profile window is per-fit, not per-Trainer
+        # graceful preemption: SIGTERM finishes the in-flight step, writes
+        # `latest` with the loader cursor, and unwinds as
+        # PreemptionInterrupt (the launcher's no-restart teardown rc is
+        # preserved by the CLI exiting 143). Handler installation needs the
+        # main thread (tests drive fit() from worker threads: skip there).
+        self._preempt_requested = False
+        prev_term = None
+        if threading.current_thread() is threading.main_thread():
+            def _on_term(signum, frame):
+                self._preempt_requested = True
+                logger.info(
+                    "SIGTERM received: checkpointing after the in-flight "
+                    "step, then exiting"
+                )
+
+            prev_term = signal.signal(signal.SIGTERM, _on_term)
         try:
             history, best_accuracy = self._epoch_loop(
                 train_loader, val_loader, start_epoch, epochs,
-                best_accuracy, writer,
+                best_accuracy, writer, start_batch,
             )
         finally:
+            if prev_term is not None:
+                signal.signal(signal.SIGTERM, prev_term)
             # an exception mid-window must not leave a dangling active
             # jax trace, an unflushed metrics file, or a half-queued save
             if self._profiler is not None:
@@ -239,13 +351,21 @@ class Trainer:
 
     def _epoch_loop(
         self, train_loader, val_loader, start_epoch, epochs,
-        best_accuracy, writer,
+        best_accuracy, writer, start_batch=0,
     ):
-        """Runs epochs; returns (history, best_accuracy-so-far incl. resume)."""
+        """Runs epochs; returns (history, best_accuracy-so-far incl. resume).
+
+        ``self._best_accuracy`` is the single live copy (mid-epoch saves
+        read it); the parameter only seeds it across resume.
+        """
         history: List[Dict[str, float]] = []
+        self._best_accuracy = best_accuracy
         for epoch in range(start_epoch, epochs):
             epoch_start = time.time()
-            train_metrics = self.train_epoch(train_loader, epoch)
+            train_metrics = self.train_epoch(
+                train_loader, epoch,
+                start_batch=start_batch if epoch == start_epoch else 0,
+            )
             train_time = time.time() - epoch_start
             val_metrics = self.validate(val_loader) if val_loader is not None else {}
             epoch_time = time.time() - epoch_start
@@ -266,9 +386,13 @@ class Trainer:
                 if k not in ("loss", "accuracy")
             })
             if global_batch:
-                # training throughput only: validation time excluded
+                # training throughput only: validation time excluded; a
+                # mid-epoch-resumed first epoch ran fewer batches
+                batches_run = len(train_loader) - (
+                    start_batch if epoch == start_epoch else 0
+                )
                 record["samples_per_sec"] = (
-                    len(train_loader) * global_batch / train_time
+                    batches_run * global_batch / train_time
                 )
             history.append(record)
             writer.write(record)
@@ -289,12 +413,13 @@ class Trainer:
                     )
 
             is_best = (
-                val_loader is not None and record["val_accuracy"] > best_accuracy
+                val_loader is not None
+                and record["val_accuracy"] > self._best_accuracy
             )
             if is_best:
-                best_accuracy = record["val_accuracy"]
+                self._best_accuracy = record["val_accuracy"]
             if self.checkpoint_dir:
-                extra = {"best_accuracy": best_accuracy}
+                extra = {"best_accuracy": self._best_accuracy}
                 # epoch+1 so resume continues AFTER the finished epoch
                 if is_best:
                     ckpt_lib.save_checkpoint(
@@ -316,4 +441,4 @@ class Trainer:
                     sharded=self._sharded_ckpt(),
                 )
             dist.barrier("epoch-end")
-        return history, best_accuracy
+        return history, self._best_accuracy

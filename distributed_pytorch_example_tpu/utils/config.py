@@ -124,6 +124,11 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     parser.add_argument("--metrics-file", type=str, default=None,
                         help="JSONL epoch-metrics path (default: "
                         "<checkpoint-dir>/metrics.jsonl)")
+    parser.add_argument("--save-every-steps", type=int, default=0,
+                        help=">0: also write `latest` every N train batches "
+                        "with the loader cursor, so --resume restarts at "
+                        "the exact batch (step-level resume; a preemption "
+                        "loses at most N batches instead of an epoch)")
     parser.add_argument("--optimizer", type=str, default="adam",
                         choices=("adam", "adamw", "sgd", "lamb", "adafactor"),
                         help="reference default: adam (train.py:249); "

@@ -1,0 +1,165 @@
+"""Step-level resume: kill mid-epoch, restart at the exact batch.
+
+Beyond-reference capability (the reference resumes at epoch granularity,
+train.py:256-257): ``--save-every-steps`` checkpoints carry the loader
+cursor (epoch, batch_in_epoch), and resume skips to that batch. The
+determinism contract that makes this PROVABLE: the sampler permutation is
+a pure function of (seed, epoch) (data/sampler.py), and the per-step rng
+folds the checkpointed ``state.rng`` with the checkpointed ``state.step``
+(train/step.py) — so a SIGKILLed-and-resumed run's per-batch losses must
+equal an uninterrupted control's exactly.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# 800 steps/epoch so the victim is reliably mid-epoch when the SIGKILL
+# lands (a tiny run finishes before the signal can be delivered)
+BASE_ARGS = [
+    "--epochs", "2", "--num-samples", "12800", "--batch-size", "2",
+    "--log-every", "1", "--seed", "5", "--lr", "0.01",
+]
+
+LOSS_RE = re.compile(r"Epoch (\d+), Batch (\d+)/\d+, Loss: ([0-9.]+)")
+
+
+def _env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # force CPU past the axon plugin
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    return env
+
+
+def _losses(stderr: str) -> dict:
+    """{(epoch, batch): 'loss string'} from --log-every 1 output."""
+    return {
+        (int(m.group(1)), int(m.group(2))): m.group(3)
+        for m in LOSS_RE.finditer(stderr)
+    }
+
+
+def _run(args, timeout=600):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "train.py"), *args],
+        capture_output=True, text=True, env=_env(), cwd=REPO,
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stderr
+
+
+@pytest.mark.slow
+def test_sigkill_mid_epoch_resumes_bit_identical(tmp_path):
+    ctrl_dir, vict_dir = str(tmp_path / "ctrl"), str(tmp_path / "vict")
+
+    # 1. uninterrupted control
+    ctrl_err = _run([*BASE_ARGS, "--checkpoint-dir", ctrl_dir])
+    ctrl = _losses(ctrl_err)
+    assert (0, 0) in ctrl and (1, 799) in ctrl  # 800 batches x 2 epochs
+
+    # 2. victim: per-step checkpoints, SIGKILLed once batch 3 of epoch 0
+    # has run (so `latest` carries a mid-epoch cursor)
+    victim = subprocess.Popen(
+        [
+            sys.executable, os.path.join(REPO, "train.py"), *BASE_ARGS,
+            "--checkpoint-dir", vict_dir, "--save-every-steps", "1",
+        ],
+        stderr=subprocess.PIPE, text=True, env=_env(), cwd=REPO,
+    )
+    import threading
+
+    seen = []
+    # watchdog: a wedged victim that stops logging would block the pipe
+    # read forever; killing it closes the pipe and fails the test loudly
+    watchdog = threading.Timer(600, victim.kill)
+    watchdog.start()
+    try:
+        for line in victim.stderr:
+            seen.append(line)
+            m = LOSS_RE.search(line)
+            if m and (int(m.group(1)), int(m.group(2))) >= (0, 3):
+                break
+        else:
+            raise AssertionError(
+                "victim exited/wedged before batch 3:\n" + "".join(seen[-30:])
+            )
+    finally:
+        watchdog.cancel()
+    # no settling sleep: dozens of async per-step saves have landed by now
+    victim.send_signal(signal.SIGKILL)
+    victim.wait(timeout=60)
+    victim.stderr.close()
+
+    ckpt = os.path.join(vict_dir, "latest_model.ckpt")
+    assert os.path.exists(ckpt), "no mid-epoch checkpoint survived the kill"
+
+    # 3. resume: must restart MID-epoch at the checkpointed cursor
+    res_err = _run(
+        [*BASE_ARGS, "--checkpoint-dir", vict_dir, "--resume", ckpt]
+    )
+    m = re.search(r"Resuming epoch (\d+) at batch (\d+)/800", res_err)
+    assert m, res_err[-2000:]
+    resume_at = (int(m.group(1)), int(m.group(2)))
+    assert (0, 1) <= resume_at <= (1, 799)
+
+    # 4. bit-identical trajectory: every post-resume (epoch, batch) loss
+    # equals the control's, and the pre-kill victim losses do too
+    res = _losses(res_err)
+    expected = {k: v for k, v in ctrl.items() if k >= resume_at}
+    assert expected, "control produced no comparable steps"
+    for key, loss in expected.items():
+        assert res.get(key) == loss, (
+            f"loss diverged at {key}: resumed {res.get(key)} != control {loss}"
+        )
+    vict = _losses("".join(seen))
+    for key, loss in vict.items():
+        assert ctrl[key] == loss, f"victim diverged at {key} pre-kill"
+
+    # 5. final state equality: metrics.jsonl last epoch records match the
+    # control exactly (full-precision floats)
+    def last_record(d):
+        with open(os.path.join(d, "metrics.jsonl")) as f:
+            return json.loads(f.readlines()[-1])
+
+    ctrl_rec, res_rec = last_record(ctrl_dir), last_record(vict_dir)
+    for k in ("epoch", "val_loss", "val_accuracy"):
+        assert ctrl_rec[k] == res_rec[k], (k, ctrl_rec[k], res_rec[k])
+
+
+def test_iter_from_matches_tail_of_full_iteration(devices):
+    """loader.iter_from(k) yields exactly the batches a full iteration
+    yields from step k on (the cursor contract resume relies on)."""
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+    from distributed_pytorch_example_tpu.data.synthetic import (
+        SyntheticClassificationDataset,
+    )
+
+    ds = SyntheticClassificationDataset(num_samples=40)
+    loader = DeviceLoader(ds, 8, num_shards=1, shard_id=0, seed=3)
+    loader.set_epoch(2)
+    full = [
+        {k: np.asarray(v) for k, v in b.items()} for b in iter(loader)
+    ]
+    loader.set_epoch(2)
+    tail = [
+        {k: np.asarray(v) for k, v in b.items()} for b in loader.iter_from(2)
+    ]
+    assert len(tail) == len(full) - 2
+    for a, b in zip(full[2:], tail):
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+    with pytest.raises(ValueError, match="start_step"):
+        list(loader.iter_from(len(loader) + 1))

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
 
 import jax.numpy as jnp
 import optax
@@ -315,13 +316,22 @@ def main():
         profile_dir=args.profile_dir,
         profile_window=profile_window,
         checkpoint_format=args.checkpoint_format,
+        save_every_steps=args.save_every_steps,
     )
-    trainer.fit(
-        train_loader,
-        val_loader,
-        epochs=args.epochs,
-        resume=args.resume,
-    )
+    try:
+        trainer.fit(
+            train_loader,
+            val_loader,
+            epochs=args.epochs,
+            resume=args.resume,
+        )
+    except dpx.train.PreemptionInterrupt:
+        # graceful SIGTERM teardown: the checkpoint landed in fit(); exit
+        # with the conventional TERM rc so the launcher does NOT restart
+        # (launch/entrypoint.sh:133-141) — the next launch resumes at the
+        # saved batch
+        dpx.runtime.shutdown()
+        sys.exit(143)
     dpx.runtime.shutdown()
 
 
