@@ -96,9 +96,69 @@ def _hybrid_shapes(spec: "MeshSpec", n_slices: int):
     return None
 
 
+def _hybrid_device_array(per_slice, dcn, devices, n_slices):
+    """Device array for a multi-slice mesh: DCN boundary on one axis.
+
+    First choice is jax's ``create_hybrid_device_mesh`` (TPU devices carry
+    ``slice_index``); environments whose devices don't (virtual CPU slices
+    in tests/dryruns, where the slice structure is declared via
+    ``make_mesh(n_slices=...)``) get a manual construction: the device
+    list is partitioned into ``n_slices`` contiguous groups, each group
+    laid out as its own per-slice mesh, and the groups concatenated along
+    the DCN axis — so crossing that axis IS crossing the slice boundary.
+    """
+    from jax.experimental import mesh_utils
+
+    try:
+        return mesh_utils.create_hybrid_device_mesh(
+            per_slice, dcn, devices=devices
+        )
+    except Exception:
+        if getattr(devices[0], "slice_index", None) is not None:
+            # real multi-slice devices where jax's own construction failed:
+            # the manual layout below may not respect physical slice
+            # membership if the list isn't slice-contiguous — surface it
+            from distributed_pytorch_example_tpu.runtime.logging import (
+                get_logger,
+            )
+
+            get_logger(__name__).warning(
+                "create_hybrid_device_mesh failed on devices that carry "
+                "slice_index; building the hybrid layout manually by "
+                "grouping on slice_index — verify the mesh if slices are "
+                "unevenly populated"
+            )
+    if getattr(devices[0], "slice_index", None) is not None:
+        # group by the devices' actual slice membership, not list order
+        by_slice = {}
+        for d in devices:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        groups = [by_slice[k] for k in sorted(by_slice)]
+    else:
+        # virtual slices (CPU tests/dryruns): contiguous list-order groups
+        groups = [
+            devices[
+                i * (len(devices) // n_slices):
+                (i + 1) * (len(devices) // n_slices)
+            ]
+            for i in range(n_slices)
+        ]
+    slice_arrays = []
+    for g in groups:
+        try:
+            slice_arrays.append(
+                mesh_utils.create_device_mesh(per_slice, devices=g)
+            )
+        except Exception:
+            slice_arrays.append(np.array(g).reshape(per_slice))
+    axis = dcn.index(n_slices)
+    return np.concatenate(slice_arrays, axis=axis)
+
+
 def make_mesh(
     spec: Optional[MeshSpec] = None,
     devices: Optional[Sequence] = None,
+    n_slices: Optional[int] = None,
 ):
     """Build a ``jax.sharding.Mesh`` over all (or given) devices.
 
@@ -110,7 +170,9 @@ def make_mesh(
     axis order matches the physical ICI topology (fastest-varying axes get
     the tightest links). Multi-slice jobs (devices spanning several TPU
     slices connected over DCN) get a hybrid mesh with the slice dimension
-    on the ``data`` axis — see :func:`_hybrid_shapes`.
+    on the ``data`` axis — see :func:`_hybrid_shapes`. ``n_slices``
+    overrides slice detection for devices that don't report
+    ``slice_index`` (virtual CPU slices in tests/dryruns).
     """
     import jax
     from jax.experimental import mesh_utils
@@ -121,36 +183,38 @@ def make_mesh(
     devices = list(devices)
     spec = (spec or MeshSpec()).resolve(len(devices))
     shape = tuple(spec.axis_sizes())
-    if len(devices) == len(jax.devices()) and devices == list(jax.devices()):
+    if n_slices is None:
         n_slices = _num_slices(devices)
-        hybrid = _hybrid_shapes(spec, n_slices)
+    if n_slices > 1 and len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into {n_slices} slices"
+        )
+    spans_all = (
+        len(devices) == len(jax.devices()) and devices == list(jax.devices())
+    )
+    hybrid = _hybrid_shapes(spec, n_slices)
+    if hybrid is not None:
+        per_slice, dcn = hybrid
+        dev_array = _hybrid_device_array(per_slice, dcn, devices, n_slices)
+    elif spans_all:
         try:
-            if hybrid is not None:
-                per_slice, dcn = hybrid
-                dev_array = mesh_utils.create_hybrid_device_mesh(
-                    per_slice, dcn, devices=devices
-                )
-            else:
-                dev_array = mesh_utils.create_device_mesh(
-                    shape, devices=devices
-                )
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
         except Exception:
             dev_array = np.array(devices).reshape(shape)
-            if n_slices > 1:
-                from distributed_pytorch_example_tpu.runtime.logging import (
-                    get_logger,
-                )
-
-                get_logger(__name__).warning(
-                    "multi-slice job (%d slices) fell back to a naive "
-                    "device layout: the mesh is NOT DCN-aware and "
-                    "cross-slice links may land inside ICI axes. Check "
-                    "that a batch axis (data/fsdp) is divisible by the "
-                    "slice count.",
-                    n_slices,
-                )
     else:
         dev_array = np.array(devices).reshape(shape)
+    if n_slices > 1 and hybrid is None:
+        from distributed_pytorch_example_tpu.runtime.logging import (
+            get_logger,
+        )
+
+        get_logger(__name__).warning(
+            "multi-slice job (%d slices) fell back to a naive device "
+            "layout: the mesh is NOT DCN-aware and cross-slice links may "
+            "land inside ICI axes. Check that a batch axis (data/fsdp) is "
+            "divisible by the slice count.",
+            n_slices,
+        )
     return Mesh(dev_array, spec.axis_names)
 
 

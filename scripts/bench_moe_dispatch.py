@@ -1,0 +1,170 @@
+#!/usr/bin/env python3
+"""Dense MoE dispatch scaling in the expert count (VERDICT r4 ask #8).
+
+The dense-dispatch design (models/moe.py) builds (B, S, E, C) one-hot
+dispatch/combine tensors. The scaling worry is O(S*E*C) — but capacity is
+C = ceil(top_k * S / E * cf), so E*C ~ top_k * cf * S is CONSTANT in E:
+analytically the dispatch einsums' FLOPs and the dispatch tensor bytes are
+flat in E at fixed token count (quadratic in S, which is the real design
+limit). This script turns that argument into a measured curve:
+
+1. one MoE layer (fwd+bwd) at fixed tokens, E in {4..128};
+2. a full tiny-LM train step at E in {4, 16, 64}.
+
+If the curve is flat, dense dispatch holds at production expert counts
+and a sorted/ragged path is unjustified complexity; if it grows, the
+growth IS the case for one.
+
+Run on the TPU:  python scripts/bench_moe_dispatch.py \
+    [--json results/moe_dispatch/scaling.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fence(x) -> float:
+    """Fetch a real value — block_until_ready is not a reliable fence over
+    the tunneled TPU client (bench.py convention)."""
+    import jax.numpy as jnp
+
+    return float(jnp.sum(x[0]) if isinstance(x, tuple) else jnp.sum(x))
+
+
+def bench_layer(E: int, *, B=8, S=1024, D=512, M=1024, top_k=2, cf=1.25,
+                steps=30, warmup=5) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_example_tpu.models.moe import moe_apply
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((B, S, D)), jnp.bfloat16)
+    logits = jnp.asarray(rng.standard_normal((B, S, E)), jnp.float32)
+    params = {
+        "up_kernel": jnp.asarray(
+            rng.standard_normal((E, D, M)) * 0.02, jnp.float32
+        ),
+        "up_bias": jnp.zeros((E, M), jnp.float32),
+        "down_kernel": jnp.asarray(
+            rng.standard_normal((E, M, D)) * 0.02, jnp.float32
+        ),
+        "down_bias": jnp.zeros((E, D), jnp.float32),
+    }
+
+    def loss(params, x, logits):
+        y, aux = moe_apply(
+            x, logits, params, top_k=top_k, capacity_factor=cf,
+            dtype=jnp.bfloat16,
+        )
+        return jnp.sum(y.astype(jnp.float32) ** 2) + aux["load_balancing"]
+
+    grad = jax.jit(jax.value_and_grad(loss))
+    compiled = grad.lower(params, x, logits).compile()
+    out = None
+    for _ in range(warmup):
+        out = compiled(params, x, logits)
+    _fence(out[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = compiled(params, x, logits)
+    _fence(out[0])
+    dt = (time.perf_counter() - t0) / steps
+    C = -(-top_k * S * cf // E)
+    return {
+        "kind": "layer", "experts": E, "capacity": int(C),
+        "tokens": B * S, "ms_per_step": round(dt * 1e3, 3),
+        "tokens_per_sec": round(B * S / dt),
+    }
+
+
+def bench_model(E: int, *, steps=20, warmup=5) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
+
+    model = dpx.models.get_model(
+        "gpt2", dtype=jnp.bfloat16, logits_mode="hidden",
+        model_dim=512, num_layers=4, num_heads=8, mlp_dim=1024,
+        max_len=1024, moe_experts=E, moe_every=2, moe_top_k=2,
+    )
+    mesh = dpx.runtime.make_mesh()
+    partitioner = dpx.parallel.data_parallel(mesh)
+    trainer = dpx.train.Trainer(
+        model, CausalLMTask(), optax.adam(1e-3), partitioner=partitioner
+    )
+    tokens = np.random.default_rng(0).integers(
+        0, model.vocab_size, (8, 1024)
+    ).astype(np.int32)
+    batch = {
+        "tokens": jax.make_array_from_process_local_data(
+            partitioner.batch_sharding(), tokens
+        )
+    }
+    with mesh:
+        trainer.init(batch["tokens"])
+        compiled = trainer.train_step.lower(trainer.state, batch).compile()
+        state = trainer.state
+        metrics = None
+        for _ in range(warmup):
+            state, metrics = compiled(state, batch)
+        float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = compiled(state, batch)
+        float(metrics["loss"])
+        dt = (time.perf_counter() - t0) / steps
+    return {
+        "kind": "model", "experts": E, "tokens": tokens.size,
+        "ms_per_step": round(dt * 1e3, 3),
+        "tokens_per_sec": round(tokens.size / dt),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", default=None)
+    parser.add_argument("--layer-experts", default="4,8,16,32,64,128")
+    parser.add_argument("--model-experts", default="4,16,64")
+    args = parser.parse_args()
+
+    rows = []
+    for E in (int(e) for e in args.layer_experts.split(",")):
+        row = bench_layer(E)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    for E in (int(e) for e in args.model_experts.split(",")):
+        row = bench_model(E)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    layer = [r for r in rows if r["kind"] == "layer"]
+    summary = {
+        "layer_ms_E4_to_E128": [layer[0]["ms_per_step"],
+                                layer[-1]["ms_per_step"]],
+        "layer_growth_x": round(
+            layer[-1]["ms_per_step"] / layer[0]["ms_per_step"], 3
+        ),
+    }
+    print(json.dumps(summary), flush=True)
+    if args.json:
+        os.makedirs(os.path.dirname(args.json), exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump({"rows": rows, "summary": summary}, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

