@@ -25,7 +25,31 @@ import jax
 import jax.numpy as jnp
 
 from distributed_pytorch_example_tpu.models.moe import MoEMlpBlock
-from distributed_pytorch_example_tpu.ops.attention import dot_product_attention
+from distributed_pytorch_example_tpu.ops.attention import (
+    dot_product_attention,
+    fused_layout_eligible,
+)
+
+
+class _DenseParams(nn.Module):
+    """Owns an nn.Dense-compatible (kernel, bias) WITHOUT applying them.
+
+    The fused projection layout needs the raw arrays (it contracts them in
+    a reshaped einsum); names/init mirror nn.Dense exactly so the param
+    tree — and therefore checkpoints — stay identical whichever attention
+    path a platform takes.
+    """
+
+    features: int
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (in_features, self.features),
+        )
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        return kernel, bias
 
 
 def tied_head_logits(x, embedding, dtype) -> jax.Array:
@@ -80,10 +104,35 @@ class MultiHeadAttention(nn.Module):
             )
         features = self.num_heads * self.head_dim
         kv_features = kv_heads * self.head_dim
+        batch, seq = x.shape[0], x.shape[1]
+        # fused projection layout: when the flash kernel will serve this
+        # call anyway, project straight to its head-major (B, N, S, H)
+        # layout (einsum prologue/epilogue) instead of paying the
+        # transpose sandwich — measured ~0.22 ms/layer fwd+bwd at GPT-2
+        # bench shapes (results/lm_mfu_analysis/bsnh_ab.json). Static
+        # decision (shapes/dtype/platform), so a given model instance
+        # always creates the same param tree; the `_DenseParams` modules
+        # mirror nn.Dense's names/init exactly, keeping checkpoints
+        # interchangeable between the paths.
+        fused = (
+            not self.decode
+            and not self.rope
+            and mask is None
+            and kv_mask is None
+            and self.seq_axis is None
+            and fused_layout_eligible(
+                batch, seq, self.num_heads, kv_heads, self.head_dim,
+                jnp.dtype(self.dtype), causal=self.causal,
+                use_flash=self.use_flash,
+            )
+        )
+        if fused:
+            return self._fused_layout_attention(
+                x, features, kv_features, kv_heads, train
+            )
         q = nn.Dense(features, dtype=self.dtype, name="q")(x)
         k = nn.Dense(kv_features, dtype=self.dtype, name="k")(x)
         v = nn.Dense(kv_features, dtype=self.dtype, name="v")(x)
-        batch, seq = x.shape[0], x.shape[1]
         q = q.reshape(batch, seq, self.num_heads, self.head_dim)
         k = k.reshape(batch, seq, kv_heads, self.head_dim)
         v = v.reshape(batch, seq, kv_heads, self.head_dim)
@@ -135,6 +184,45 @@ class MultiHeadAttention(nn.Module):
             )
         out = out.reshape((batch, seq, features))
         out = nn.Dense(self.model_dim, dtype=self.dtype, name="o")(out)
+        if self.dropout_rate:
+            out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
+        return out
+
+    def _fused_layout_attention(self, x, features, kv_features, kv_heads,
+                                train):
+        """Head-major attention: projections emit (B, N, S, H) directly.
+
+        einsum('bsd,dnh->bnsh') prologue + einsum('bnsh,nhd->bsd')
+        epilogue around the transpose-free flash entry
+        (ops/pallas/flash_attention.flash_attention_bnsh) — no standalone
+        transpose op for XLA to schedule. A/B-measured worth ~2% of the
+        GPT-2 bench step (results/lm_mfu_analysis/bsnh_ab.json).
+        """
+        from distributed_pytorch_example_tpu.ops.pallas.flash_attention import (
+            flash_attention_bnsh,
+        )
+
+        n, kv_n, h = self.num_heads, kv_heads, self.head_dim
+        in_dim = x.shape[-1]
+        dt = self.dtype
+        kq, bq = _DenseParams(features, name="q")(in_dim)
+        kk, bk = _DenseParams(kv_features, name="k")(in_dim)
+        kv_w, bv = _DenseParams(kv_features, name="v")(in_dim)
+        ko, bo = _DenseParams(self.model_dim, name="o")(features)
+        xd = x.astype(dt)
+
+        def project(w, b, heads):
+            return jnp.einsum(
+                "bsd,dnh->bnsh", xd, w.reshape(in_dim, heads, h).astype(dt)
+            ) + b.reshape(heads, h).astype(dt)[None, :, None, :]
+
+        q = project(kq, bq, n)
+        k = project(kk, bk, kv_n)
+        v = project(kv_w, bv, kv_n)
+        out = flash_attention_bnsh(q, k, v, causal=self.causal)
+        out = jnp.einsum(
+            "bnsh,nhd->bsd", out, ko.reshape(n, h, self.model_dim).astype(dt)
+        ) + bo.astype(dt)
         if self.dropout_rate:
             out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
         return out

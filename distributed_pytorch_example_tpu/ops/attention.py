@@ -165,6 +165,26 @@ def _flash_lane_padded(q, k, v, kv_mask, causal, softmax_scale,
     return out[:, :seq]
 
 
+def fused_layout_eligible(
+    batch: int, seq: int, heads: int, kv_heads: int, head_dim: int, dtype,
+    *, causal: bool, use_flash: Optional[bool],
+) -> bool:
+    """True when the flash kernel would serve this self-attention AND the
+    caller can use the head-major fused projection layout — project
+    straight to (B, N, S, H) with einsum('bsd,dnh->bnsh') and skip the
+    transpose sandwich (measured ~0.22 ms/layer at GPT-2 bench shapes,
+    results/lm_mfu_analysis/bsnh_ab.json). The decision must be taken
+    BEFORE the projections run, hence this static probe; masks, decode,
+    RoPE, and sequence parallelism all disqualify (their paths are
+    (B, S, N, H)-shaped).
+    """
+    if use_flash is False:
+        return False
+    q = jax.ShapeDtypeStruct((batch, seq, heads, head_dim), dtype)
+    kv = jax.ShapeDtypeStruct((batch, seq, kv_heads, head_dim), dtype)
+    return _flash_unsupported_reason(q, kv, kv, None, causal) is None
+
+
 @functools.lru_cache(maxsize=1)
 def _on_tpu() -> bool:
     try:

@@ -143,16 +143,22 @@ def _hybrid_device_array(per_slice, dcn, devices, n_slices):
             ]
             for i in range(n_slices)
         ]
-    slice_arrays = []
-    for g in groups:
-        try:
-            slice_arrays.append(
-                mesh_utils.create_device_mesh(per_slice, devices=g)
-            )
-        except Exception:
-            slice_arrays.append(np.array(g).reshape(per_slice))
-    axis = dcn.index(n_slices)
-    return np.concatenate(slice_arrays, axis=axis)
+    try:
+        slice_arrays = []
+        for g in groups:
+            try:
+                slice_arrays.append(
+                    mesh_utils.create_device_mesh(per_slice, devices=g)
+                )
+            except Exception:
+                slice_arrays.append(np.array(g).reshape(per_slice))
+        axis = dcn.index(n_slices)
+        return np.concatenate(slice_arrays, axis=axis)
+    except Exception:
+        # e.g. unevenly populated slices after partial loss: a group can't
+        # fill per_slice. Degrade to the naive layout (caller warns) rather
+        # than killing the job at mesh construction.
+        return None
 
 
 def make_mesh(
@@ -196,6 +202,9 @@ def make_mesh(
     if hybrid is not None:
         per_slice, dcn = hybrid
         dev_array = _hybrid_device_array(per_slice, dcn, devices, n_slices)
+        if dev_array is None:  # degraded: fall through to naive + warning
+            hybrid = None
+            dev_array = np.array(devices).reshape(shape)
     elif spans_all:
         try:
             dev_array = mesh_utils.create_device_mesh(shape, devices=devices)

@@ -61,7 +61,12 @@ def run(seq_len: int, batch: int, steps: int, warmup: int) -> dict:
         u, new_opt = tx.update(g, opt, params)
         return optax.apply_updates(params, u), new_opt, m
 
-    compiled = jax.jit(step).lower(params, opt, tokens).compile()
+    # donate params/optimizer state like the real Trainer step does —
+    # without it the 32k config carries an extra ~1.7 GB of undonated
+    # outputs and OOMs the 16 GB chip
+    compiled = jax.jit(
+        step, donate_argnums=(0, 1)
+    ).lower(params, opt, tokens).compile()
     try:
         analysis = compiled.cost_analysis()
         if isinstance(analysis, (list, tuple)):
@@ -69,14 +74,14 @@ def run(seq_len: int, batch: int, steps: int, warmup: int) -> dict:
         flops = float(analysis["flops"])
     except Exception:
         flops = None
-    out = None
+    m = None
     for _ in range(warmup):
-        out = compiled(params, opt, tokens)
-    float(out[2]["loss"])  # tunnel fence (see bench.py)
+        params, opt, m = compiled(params, opt, tokens)
+    float(m["loss"])  # tunnel fence (see bench.py)
     t0 = time.perf_counter()
     for _ in range(steps):
-        out = compiled(params, opt, tokens)
-    float(out[2]["loss"])
+        params, opt, m = compiled(params, opt, tokens)
+    float(m["loss"])
     dt = (time.perf_counter() - t0) / steps
 
     tokens_total = batch * seq_len

@@ -58,9 +58,10 @@ def main():
     rng = np.random.default_rng(0)
     is_vision = args.model.startswith(("resnet", "vit", "mlp"))
     if is_vision:
-        model = dpx.models.get_model(
-            args.model, dtype=jnp.bfloat16, num_classes=args.num_classes
-        )
+        overrides = {"dtype": jnp.bfloat16, "num_classes": args.num_classes}
+        if args.remat:  # vit supports it; unsupported models fail loudly
+            overrides["remat"] = True
+        model = dpx.models.get_model(args.model, **overrides)
         task = ClassificationTask()
         n = args.batch * len(jax.devices())
         batch_np = {

@@ -426,3 +426,63 @@ def test_gqa_indivisible_heads_not_selected():
     q = jnp.asarray(rng.standard_normal((1, 128, 6, 64)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((1, 128, 4, 64)), jnp.float32)
     assert "heads" in _flash_unsupported_reason(q, k, k, None, False)
+
+
+def test_fused_layout_attention_matches_classic(monkeypatch):
+    """The fused projection layout (einsum prologue -> BNSH kernel ->
+    einsum epilogue, models/transformer.py) computes the SAME attention
+    as the classic Dense -> reshape -> flash path, with the identical
+    param tree (checkpoints interchangeable between platforms/paths).
+    CPU drive: eligibility forced, kernel in interpret mode."""
+    import functools
+
+    import numpy as np
+    import optax
+
+    from distributed_pytorch_example_tpu.models import transformer as tf_mod
+    from distributed_pytorch_example_tpu.ops.pallas import (
+        flash_attention as fa_mod,
+    )
+
+    mha = tf_mod.MultiHeadAttention(
+        num_heads=2, head_dim=64, model_dim=128, causal=True,
+    )
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((2, 128, 128)) * 0.3,
+        jnp.float32,
+    )
+    params = mha.init(jax.random.key(0), x, train=False)["params"]
+    classic = mha.apply({"params": params}, x, train=False)
+
+    monkeypatch.setattr(tf_mod, "fused_layout_eligible", lambda *a, **k: True)
+    monkeypatch.setattr(
+        fa_mod, "flash_attention_bnsh",
+        functools.partial(fa_mod.flash_attention_bnsh, interpret=True),
+    )
+    fused_params = mha.init(jax.random.key(0), x, train=False)["params"]
+    # identical param tree and values between the two paths
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        params, fused_params,
+    )
+    fused = mha.apply({"params": params}, x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(classic), atol=2e-5
+    )
+
+    # gradients agree too (the custom-VJP backward under the new layout)
+    g_fused = jax.grad(lambda p: jnp.sum(
+        mha.apply({"params": p}, x, train=False) ** 2
+    ))(params)
+    monkeypatch.undo()
+    g_classic = jax.grad(lambda p: jnp.sum(
+        mha.apply({"params": p}, x, train=False) ** 2
+    ))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4
+        ),
+        g_fused, g_classic,
+    )
