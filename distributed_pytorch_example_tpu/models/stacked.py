@@ -68,11 +68,6 @@ def validate_pipe_schedule(mod, targets):
     if mod.pipe_schedule == "1f1b":
         if mod.pipe_axis is None:
             raise ValueError("pipe_schedule='1f1b' requires pipe_axis")
-        if mod.moe_experts:
-            raise ValueError(
-                "pipe_schedule='1f1b' does not serve MoE yet; use the "
-                "GPipe schedule for MoE pipelines"
-            )
         if mod.seq_axis:
             raise ValueError(
                 "pipe_schedule='1f1b' does not compose with seq_axis yet "
@@ -287,15 +282,19 @@ def _run_stacked(mod, params, x, block, aux_init=None):
     return out, aux_sum, float(n_micro)
 
 
-def _run_stacked_1f1b(mod, params, x, last, block):
+def _run_stacked_1f1b(mod, params, x, last, block, moe: bool = False):
     """1F1B train pass: loss computed per microbatch at the last stage.
 
     ``last`` is ``(last_fn, last_params, last_args)`` from the parent model
     (final norm + head + loss for ONE microbatch — see
     parallel/pipeline.py one_f_one_b). Returns the primitive's
-    ``(loss_sum, metric_sums, aux_sums)``; normalize by ``n_micro``
-    outside. MoE stacks are not yet served here (GPipe remains the MoE
-    schedule); the parent models enforce that.
+    ``(loss_sum, metric_sums, aux_sums)`` plus ``n_micro``; normalize by
+    ``n_micro`` outside.
+
+    ``moe=True``: ``block`` returns ``(h, aux)`` per layer; per-stage aux
+    sums ride the schedule and their GRADIENT contribution is seeded
+    inside with the model's declared weights (one_f_one_b aux_weights —
+    the returned aux values are reporting-only by that contract).
     """
     from distributed_pytorch_example_tpu.parallel.pipeline import one_f_one_b
     from distributed_pytorch_example_tpu.runtime.mesh import (
@@ -330,20 +329,82 @@ def _run_stacked_1f1b(mod, params, x, last, block):
         lambda v: v.reshape(pipe, L // pipe, *v.shape[1:]), params
     )
 
-    def stage_fn(stage_params, h):
-        def body(hh, lp):
-            return block(lp, hh), None
+    aux_weights = None
+    if moe:
+        aux_weights = {
+            "load_balancing": float(mod.moe_aux_loss_weight),
+            "router_z": float(mod.moe_z_loss_weight),
+            "dropped_fraction": 0.0,  # observability metric, not a loss
+        }
 
-        out, _ = lax.scan(body, h, stage_params)
-        return out
+    def stage_fn(stage_params, h):
+        if not moe:
+            def body(hh, lp):
+                return block(lp, hh), None
+
+            out, _ = lax.scan(body, h, stage_params)
+            return out
+
+        from distributed_pytorch_example_tpu.parallel.api import pvary_like
+
+        zeros = pvary_like(
+            {k: jnp.zeros((), jnp.float32) for k in aux_weights},
+            h, (mod.pipe_axis,),
+        )
+
+        def body(carry, lp):
+            hh, acc = carry
+            hh, aux = block(lp, hh)
+            acc = jax.tree_util.tree_map(jnp.add, acc, aux)
+            return (hh, acc), None
+
+        (out, acc), _ = lax.scan(body, (h, zeros), stage_params)
+        return out, acc
 
     last_fn, last_params, last_args = last
     loss_sum, mets, aux = one_f_one_b(
         stage_fn, sp, x, mesh, n_micro,
         last_fn=last_fn, last_params=last_params, last_args=last_args,
-        pipe_axis=mod.pipe_axis,
+        pipe_axis=mod.pipe_axis, aux_weights=aux_weights,
     )
     return loss_sum, mets, aux, n_micro
+
+
+def _sow_moe_aux(mod, aux_sum, n_batches):
+    """The MoE aux-sow contract, shared by the GPipe and 1F1B paths:
+    weighted batch-mean balancing/z losses into ``losses``, drop fraction
+    averaged over (batch pass, layer) into ``moe_metrics``."""
+    mod.sow(
+        "losses", "load_balancing",
+        mod.moe_aux_loss_weight * aux_sum["load_balancing"] / n_batches,
+        reduce_fn=lambda a, b: a + b,
+        init_fn=lambda: jnp.zeros((), jnp.float32),
+    )
+    mod.sow(
+        "losses", "router_z",
+        mod.moe_z_loss_weight * aux_sum["router_z"] / n_batches,
+        reduce_fn=lambda a, b: a + b,
+        init_fn=lambda: jnp.zeros((), jnp.float32),
+    )
+    if not mod.is_initializing():
+        mod.sow(
+            "moe_metrics", "dropped_fraction",
+            aux_sum["dropped_fraction"] / (n_batches * mod.num_layers),
+        )
+
+
+def _run_moe_stacked_1f1b(mod, params, x, last, block):
+    """MoE under the 1F1B schedule: aux-loss GRADIENTS are seeded inside
+    the schedule with the model's weights (aux_weights above); the sows
+    carry the weighted VALUES so the task's reported loss matches the
+    optimized objective (loss_mean + sum w * aux_mean) — the aux
+    cotangents arriving on sown values are ignored by the schedule's
+    custom VJP, so nothing double-counts."""
+    loss_sum, mets, aux_sum, n_micro = _run_stacked_1f1b(
+        mod, params, x, last, block, moe=True
+    )
+    _sow_moe_aux(mod, aux_sum, float(n_micro))
+    return loss_sum, mets, aux_sum, n_micro
 
 
 def _run_moe_stacked(mod, params, x, block):
@@ -359,23 +420,7 @@ def _run_moe_stacked(mod, params, x, block):
     out, aux_sum, n_batches = _run_stacked(
         mod, params, x, block, aux_init=aux_zero
     )
-    lb = aux_sum["load_balancing"] / n_batches
-    rz = aux_sum["router_z"] / n_batches
-    mod.sow(
-        "losses", "load_balancing", mod.moe_aux_loss_weight * lb,
-        reduce_fn=lambda a, b: a + b,
-        init_fn=lambda: jnp.zeros((), jnp.float32),
-    )
-    mod.sow(
-        "losses", "router_z", mod.moe_z_loss_weight * rz,
-        reduce_fn=lambda a, b: a + b,
-        init_fn=lambda: jnp.zeros((), jnp.float32),
-    )
-    if not mod.is_initializing():
-        mod.sow(
-            "moe_metrics", "dropped_fraction",
-            aux_sum["dropped_fraction"] / (n_batches * mod.num_layers),
-        )
+    _sow_moe_aux(mod, aux_sum, n_batches)
     return out
 
 
@@ -452,9 +497,8 @@ class StackedDecoder(nn.Module):
                 "moe_down_bias": stacked("moe_down_bias", zeros, (E, D)),
             })
             if last is not None:
-                raise ValueError(
-                    "pipe_schedule='1f1b' does not serve MoE stacks yet; "
-                    "use the GPipe schedule for MoE pipelines"
+                return _run_moe_stacked_1f1b(
+                    self, params, x, last, self._moe_block_fn(x.shape)
                 )
             return self._run_moe(params, x)
         params.update({
@@ -635,9 +679,8 @@ class StackedLlamaDecoder(nn.Module):
                 ),
             })
             if last is not None:
-                raise ValueError(
-                    "pipe_schedule='1f1b' does not serve MoE stacks yet; "
-                    "use the GPipe schedule for MoE pipelines"
+                return _run_moe_stacked_1f1b(
+                    self, params, x, last, self._moe_block_fn(x.shape)
                 )
             return _run_moe_stacked(
                 self, params, x, self._moe_block_fn(x.shape)

@@ -59,6 +59,27 @@ def _fused_head(model) -> bool:
     return getattr(model, "logits_mode", "full") == "hidden"
 
 
+def _train_mutable(model_state) -> list:
+    """Mutable collections a train-mode apply must request: the carried
+    model state plus the sown aux-loss / MoE-observability collections."""
+    mutable = list(model_state.keys()) if model_state else []
+    return mutable + ["losses", "moe_metrics"]
+
+
+def _pop_sown(new_vars, model_state):
+    """Extract (aux_loss_sum, extra_metrics, remaining_state) from a
+    mutable-apply result: ``losses`` sums into the aux loss, the
+    ``moe_metrics`` scalars average into ``moe_dropped_fraction`` —
+    reported, never added to the loss. One implementation for the
+    outer-loss and 1F1B paths so their reporting cannot diverge."""
+    new_vars = dict(new_vars)
+    losses = new_vars.pop("losses", {})
+    aux = sum(jax.tree_util.tree_leaves(losses)) if losses else 0.0
+    sown = jax.tree_util.tree_leaves(new_vars.pop("moe_metrics", {}))
+    extra = {"moe_dropped_fraction": sum(sown) / len(sown)} if sown else {}
+    return aux, extra, (new_vars or (model_state or {}))
+
+
 def _apply_model(model, params, model_state, inputs, rng, train: bool):
     """Run model.apply handling mutable collections + dropout rng.
 
@@ -74,19 +95,12 @@ def _apply_model(model, params, model_state, inputs, rng, train: bool):
     inputs = jax.tree_util.tree_map(dequantize_inputs, inputs)
     rngs = {"dropout": rng} if train else {}
     if train:
-        mutable = list(model_state.keys()) if model_state else []
-        mutable += ["losses", "moe_metrics"]
         logits, new_vars = model.apply(
-            variables, inputs, train=train, rngs=rngs, mutable=mutable
+            variables, inputs, train=train, rngs=rngs,
+            mutable=_train_mutable(model_state),
         )
-        new_vars = dict(new_vars)
-        losses = new_vars.pop("losses", {})
-        aux = sum(jax.tree_util.tree_leaves(losses)) if losses else 0.0
-        sown = jax.tree_util.tree_leaves(new_vars.pop("moe_metrics", {}))
-        extra = (
-            {"moe_dropped_fraction": sum(sown) / len(sown)} if sown else {}
-        )
-        return logits, (new_vars or (model_state or {})), aux, extra
+        aux, extra, new_ms = _pop_sown(new_vars, model_state)
+        return logits, new_ms, aux, extra
     out = model.apply(variables, inputs, train=train, rngs=rngs, mutable=False)
     return out, (model_state or {}), 0.0, {}
 
@@ -166,16 +180,17 @@ class CausalLMTask:
         variables = {"params": params, **(model_state or {})}
         (loss, mets), new_vars = model.apply(
             variables, tokens, train=True, targets=tokens,
-            rngs={"dropout": rng},
-            mutable=list(model_state.keys()) if model_state else [],
+            rngs={"dropout": rng}, mutable=_train_mutable(model_state),
         )
+        # sown aux losses (MoE balancing/z): their VALUES complete the
+        # reported objective; their gradients were already seeded inside
+        # the 1F1B schedule (aux_weights — the schedule's custom VJP
+        # ignores cotangents arriving here, so nothing double-counts)
+        aux, extra, new_ms = _pop_sown(new_vars, model_state)
+        loss = loss + aux
         n_targets = tokens.shape[0] * (tokens.shape[1] - 1)
         accuracy = 100.0 * mets["correct"] / n_targets
-        return (
-            loss,
-            {"loss": loss, "accuracy": accuracy},
-            dict(new_vars) or (model_state or {}),
-        )
+        return loss, {"loss": loss, "accuracy": accuracy, **extra}, new_ms
 
 
 class MLMTask:

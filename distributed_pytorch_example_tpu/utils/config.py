@@ -75,9 +75,9 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         choices=("gpipe", "1f1b"),
                         help="pipeline schedule: gpipe (all-forward-then-"
                         "backward) or 1f1b (interleaved; activation stash "
-                        "~n_stages instead of ~n_micro — the depth x "
-                        "sequence scaling schedule; gpt2/llama causal LM, "
-                        "no MoE yet)")
+                        "~n_stages instead of ~n_micro — the depth "
+                        "scaling schedule; gpt2/llama causal LM incl. "
+                        "MoE; SP x PP stays on gpipe)")
     parser.add_argument("--pad-token-id", type=int, default=None,
                         help="bert: mask keys at this token id out of "
                         "attention (padding); default: no padding mask")

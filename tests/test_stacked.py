@@ -438,16 +438,68 @@ def test_1f1b_rejects_seq_axis(devices):
         model.init(jax.random.key(0), jnp.zeros((2, 8), jnp.int32))
 
 
-def test_1f1b_rejects_moe(devices):
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_1f1b_moe_matches_gpipe_schedule(devices, family):
+    """PP x EP under 1F1B: aux-loss gradients are seeded inside the
+    schedule with the model's weights; total loss and grads equal the
+    GPipe schedule's (whose MoE path is pinned against sequential)."""
     from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+    from distributed_pytorch_example_tpu.models.llama import Llama
+    from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
 
-    model = GPT2(
-        vocab_size=64, max_len=32, model_dim=16, num_layers=4, num_heads=2,
-        mlp_dim=32, pipe_axis="pipe", pipe_schedule="1f1b", moe_experts=4,
-        moe_every=1, moe_top_k=2,
+    mesh = make_mesh(MeshSpec(data=2, pipe=2, expert=2))
+    task = CausalLMTask()
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(8, 16)), jnp.int32
     )
-    with pytest.raises(ValueError, match="MoE"):
-        model.init(jax.random.key(0), jnp.zeros((2, 8), jnp.int32))
+    common = dict(
+        vocab_size=64, max_len=32, model_dim=32, num_layers=2, mlp_dim=64,
+        pipe_axis="pipe", pipe_microbatches=4, logits_mode="hidden",
+        moe_experts=4, moe_every=1, moe_top_k=2,
+        # big capacity: no dropped tokens, so schedules are exactly
+        # comparable (drops are order-dependent at the margin)
+        moe_capacity_factor=8.0,
+    )
+    if family == "gpt2":
+        mk = lambda sched: GPT2(num_heads=4, pipe_schedule=sched, **common)
+    else:
+        mk = lambda sched: Llama(
+            num_heads=4, num_kv_heads=2, pipe_schedule=sched, **common
+        )
+    m_1f1b, m_gpipe = mk("1f1b"), mk("gpipe")
+    with mesh:
+        params = m_1f1b.init(jax.random.key(0), tokens, train=False)["params"]
+    rng = jax.random.key(1)
+
+    def loss_fn(model):
+        def f(p):
+            with mesh:
+                loss, mets, _ = task.compute_loss(
+                    model, p, {}, {"tokens": tokens}, rng, train=True
+                )
+            return loss, mets
+
+        return f
+
+    (l1, mets1), g1 = jax.value_and_grad(
+        loss_fn(m_1f1b), has_aux=True
+    )(params)
+    (l2, mets2), g2 = jax.value_and_grad(
+        loss_fn(m_gpipe), has_aux=True
+    )(params)
+    # total loss includes the weighted aux values on both schedules
+    np.testing.assert_allclose(float(l1), float(l2), rtol=3e-5)
+    assert "moe_dropped_fraction" in mets1 and "moe_dropped_fraction" in mets2
+    np.testing.assert_allclose(
+        float(mets1["moe_dropped_fraction"]),
+        float(mets2["moe_dropped_fraction"]), atol=1e-6,
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=7e-4
+        ),
+        g1, g2,
+    )
 
 
 # -- LLaMA-family stacked decoder (RMSNorm/RoPE/GQA/SwiGLU) -----------------
