@@ -39,6 +39,7 @@ class GPT2(nn.Module):
     moe_capacity_factor: float = 1.25
     pipe_axis: Optional[str] = None  # mesh axis for pipeline stages (PP)
     pipe_microbatches: int = 0  # 0 = auto
+    pipe_virtual: int = 1  # interleaved 1F1B virtual chunks per stage
     # "gpipe": all-forward-then-backward (autodiff through the schedule).
     # "1f1b": interleaved one-forward-one-backward — activation stash
     # bounded by ~n_stages instead of ~n_micro (parallel/pipeline.py);
@@ -149,6 +150,7 @@ class GPT2(nn.Module):
                 remat=self.remat,
                 pipe_axis=self.pipe_axis,
                 pipe_microbatches=self.pipe_microbatches,
+                pipe_virtual=self.pipe_virtual,
                 seq_axis=self.seq_axis,
                 sp_mode=self.sp_mode,
                 moe_experts=self.moe_experts,
@@ -218,22 +220,25 @@ class GPT2(nn.Module):
 
             return tied_head_logits(x, embed_table, dtype)
 
-        from distributed_pytorch_example_tpu.ops.chunked_ce import (
-            chunked_softmax_xent,
+        from distributed_pytorch_example_tpu.models.stacked import (
+            _pipe_size,
+            _sp_mesh,
+            make_chunked_ce_last,
         )
 
-        def last_fn(lp, y, tok_mb):
+        def prep(lp, y):
             sc, bs, table = lp
-            h = _layer_norm(y, sc, bs, eps, dtype)
-            tg = tok_mb[:, 1:]
-            per_tok, argmax = chunked_softmax_xent(
-                h[:, :-1], table, tg, bias=None, dtype=dtype
-            )
-            correct = (argmax == tg).sum().astype(jnp.float32)
-            return per_tok.mean(), {"correct": correct}
+            return _layer_norm(y, sc, bs, eps, dtype), table
 
+        # SP x PP x 1F1B: last_fn runs on a sequence CHUNK of one
+        # microbatch — the CE goes chunk-local (see make_chunked_ce_last)
+        sp = (
+            _sp_mesh(self.seq_axis) is not None
+            and _pipe_size(self.pipe_axis) > 1
+        )
+        last_fn, last_args = make_chunked_ce_last(prep, targets, sp)
         loss_sum, mets, _aux, n_micro = decoder(
             x, train=train,
-            last=(last_fn, (scale, bias, embed_table), targets),
+            last=(last_fn, (scale, bias, embed_table), last_args),
         )
         return loss_sum / n_micro, mets

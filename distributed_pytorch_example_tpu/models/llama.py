@@ -145,6 +145,7 @@ class Llama(nn.Module):
     remat: bool = False
     pipe_axis: Optional[str] = None  # mesh axis for pipeline stages (PP)
     pipe_microbatches: int = 0  # 0 = auto
+    pipe_virtual: int = 1  # interleaved 1F1B virtual chunks per stage
     # "gpipe" | "1f1b" — see models/gpt2.py pipe_schedule
     pipe_schedule: str = "gpipe"
     moe_experts: int = 0  # >0: Mixtral-style MoE on every moe_every-th block
@@ -235,6 +236,7 @@ class Llama(nn.Module):
                 remat=self.remat,
                 pipe_axis=self.pipe_axis,
                 pipe_microbatches=self.pipe_microbatches,
+                pipe_virtual=self.pipe_virtual,
                 seq_axis=self.seq_axis,
                 sp_mode=self.sp_mode,
                 moe_experts=self.moe_experts,
@@ -306,23 +308,23 @@ class Llama(nn.Module):
                 preferred_element_type=jnp.float32,
             )
 
-        from distributed_pytorch_example_tpu.ops.chunked_ce import (
-            chunked_softmax_xent,
+        from distributed_pytorch_example_tpu.models.stacked import (
+            _pipe_size,
+            _sp_mesh,
+            make_chunked_ce_last,
         )
 
-        def last_fn(lp, y, tok_mb):
+        def prep(lp, y):
             sc, hd = lp
-            h = _rms_norm(y, sc, eps, dtype)
-            tg = tok_mb[:, 1:]
-            per_tok, argmax = chunked_softmax_xent(
-                h[:, :-1], jnp.swapaxes(hd, 0, 1), tg, bias=None,
-                dtype=dtype,
-            )
-            correct = (argmax == tg).sum().astype(jnp.float32)
-            return per_tok.mean(), {"correct": correct}
+            return _rms_norm(y, sc, eps, dtype), jnp.swapaxes(hd, 0, 1)
 
+        sp = (
+            _sp_mesh(self.seq_axis) is not None
+            and _pipe_size(self.pipe_axis) > 1
+        )
+        last_fn, last_args = make_chunked_ce_last(prep, targets, sp)
         loss_sum, mets, _aux, n_micro = decoder(
-            x, train=train, last=(last_fn, (scale, head), targets)
+            x, train=train, last=(last_fn, (scale, head), last_args)
         )
         return loss_sum / n_micro, mets
 

@@ -65,14 +65,19 @@ def validate_pipe_schedule(mod, targets):
             f"pipe_schedule must be 'gpipe' or '1f1b', got "
             f"{mod.pipe_schedule!r}"
         )
+    if getattr(mod, "pipe_virtual", 1) > 1 and mod.pipe_schedule != "1f1b":
+        raise ValueError(
+            "pipe_virtual > 1 (interleaved virtual chunks) is only defined "
+            "for pipe_schedule='1f1b'"
+        )
     if mod.pipe_schedule == "1f1b":
         if mod.pipe_axis is None:
             raise ValueError("pipe_schedule='1f1b' requires pipe_axis")
-        if mod.seq_axis:
+        if mod.seq_axis and getattr(mod, "moe_experts", 0):
             raise ValueError(
-                "pipe_schedule='1f1b' does not compose with seq_axis yet "
-                "(the in-schedule loss would need sequence-chunked CE); "
-                "use the GPipe schedule for SP x PP"
+                "pipe_schedule='1f1b' with seq_axis does not compose with "
+                "MoE (PP x SP x EP is rejected on every schedule); drop "
+                "one of seq_axis / moe_experts"
             )
     elif targets is not None:
         raise ValueError(
@@ -257,6 +262,9 @@ def _run_stacked(mod, params, x, block, aux_init=None):
     n_micro = mod.pipe_microbatches or _auto_microbatches(
         x.shape[0], pipe, data_parallel_size(mesh)
     )
+    # GPipe stages are always the CONTIGUOUS layer split — pipe_virtual
+    # only changes the 1F1B runner's layout (the layer ORDER is identical,
+    # so eval/init through this path serves interleaved-trained params)
     sp = jax.tree_util.tree_map(
         lambda v: v.reshape(pipe, L // pipe, *v.shape[1:]), params
     )
@@ -312,22 +320,32 @@ def _run_stacked_1f1b(mod, params, x, last, block, moe: bool = False):
             "(the schedule interleaves backward across stages); run "
             "schedule='gpipe' or drop pipe_axis for single-device training"
         )
-    if _sp_mesh(getattr(mod, "seq_axis", None)) is not None:
-        raise NotImplementedError(
-            "pipe_schedule='1f1b' does not compose with sequence "
-            "parallelism yet (the in-schedule loss would need "
-            "sequence-chunked CE); use the GPipe schedule for SP x PP"
-        )
     mesh = current_mesh()
     L = mod.num_layers
-    if L % pipe:
-        raise ValueError(f"num_layers {L} not divisible by pipe size {pipe}")
+    vchunks = int(getattr(mod, "pipe_virtual", 1) or 1)
+    if L % (pipe * vchunks):
+        raise ValueError(
+            f"num_layers {L} not divisible by pipe size {pipe} x "
+            f"pipe_virtual {vchunks}"
+        )
     n_micro = mod.pipe_microbatches or _auto_microbatches(
         x.shape[0], pipe, data_parallel_size(mesh)
     )
-    sp = jax.tree_util.tree_map(
-        lambda v: v.reshape(pipe, L // pipe, *v.shape[1:]), params
-    )
+    if vchunks == 1:
+        sp = jax.tree_util.tree_map(
+            lambda v: v.reshape(pipe, L // pipe, *v.shape[1:]), params
+        )
+    else:
+        # interleaved layout: device d holds chunks j*S + d — reshape the
+        # (L, ...) stack to (v, S, L/(S*v), ...) then put the pipe dim
+        # first, so stage_params[d, j] is chunk j*S + d's layer slice
+        Lc = L // (pipe * vchunks)
+        sp = jax.tree_util.tree_map(
+            lambda v: jnp.swapaxes(
+                v.reshape(vchunks, pipe, Lc, *v.shape[1:]), 0, 1
+            ),
+            params,
+        )
 
     aux_weights = None
     if moe:
@@ -366,6 +384,7 @@ def _run_stacked_1f1b(mod, params, x, last, block, moe: bool = False):
         stage_fn, sp, x, mesh, n_micro,
         last_fn=last_fn, last_params=last_params, last_args=last_args,
         pipe_axis=mod.pipe_axis, aux_weights=aux_weights,
+        seq_axis=getattr(mod, "seq_axis", None), n_virtual=vchunks,
     )
     return loss_sum, mets, aux, n_micro
 
@@ -391,6 +410,73 @@ def _sow_moe_aux(mod, aux_sum, n_batches):
             "moe_metrics", "dropped_fraction",
             aux_sum["dropped_fraction"] / (n_batches * mod.num_layers),
         )
+
+
+def shifted_ce_last_args(targets):
+    """Pre-shifted causal-LM targets for a CHUNK-LOCAL 1F1B ``last_fn``.
+
+    The plain 1F1B ``last_fn`` shifts inside the microbatch
+    (``tok_mb[:, 1:]``) — impossible once the schedule sequence-shards its
+    arguments (SP x PP x 1F1B), because position i's target, token i+1,
+    lives in the next chunk for the last position of every chunk. Shift
+    GLOBALLY instead: return ``(tg, w)`` of the full (B, S) shape where
+    ``tg[i] = targets[i+1]`` (last position padded) and ``w`` zeroes the
+    padded position — every chunk then owns its targets, and the CE
+    becomes a masked sum that is exact under any sequence split.
+    """
+    pad = jnp.zeros((targets.shape[0], 1), targets.dtype)
+    tg = jnp.concatenate([targets[:, 1:], pad], axis=1)
+    w = jnp.broadcast_to(
+        (jnp.arange(targets.shape[1]) < targets.shape[1] - 1).astype(
+            jnp.float32
+        ),
+        targets.shape,
+    )
+    return tg, w
+
+
+def make_chunked_ce_last(prep, targets, sp):
+    """Build ``(last_fn, last_args)`` for the 1F1B in-schedule causal-LM
+    CE — the one copy of the loss scaffolding both LM families share.
+
+    ``prep(lp, y) -> (h, table)`` applies the model tail's norm and
+    exposes its (V, D) head matrix (GPT-2: LayerNorm + tied embedding;
+    LLaMA: RMSNorm + transposed untied head). With ``sp`` (SP x PP x
+    1F1B) the CE goes CHUNK-LOCAL on pre-shifted targets + validity mask
+    (:func:`shifted_ce_last_args`) normalized by the static global token
+    count — summing the per-chunk partials over the seq axis (the
+    schedule's psum) reproduces the non-SP per-microbatch mean exactly.
+    """
+    from distributed_pytorch_example_tpu.ops.chunked_ce import (
+        chunked_softmax_xent,
+    )
+
+    if sp:
+        n_tok = targets.shape[1] - 1  # valid positions per sequence
+
+        def last_fn(lp, y, args_mb):
+            tg, w = args_mb
+            h, table = prep(lp, y)
+            per_tok, argmax = chunked_softmax_xent(
+                h, table, tg, bias=None, dtype=h.dtype
+            )
+            correct = ((argmax == tg) & (w > 0)).sum().astype(jnp.float32)
+            return (per_tok * w).sum() / (y.shape[0] * n_tok), {
+                "correct": correct
+            }
+
+        return last_fn, shifted_ce_last_args(targets)
+
+    def last_fn(lp, y, tok_mb):
+        h, table = prep(lp, y)
+        tg = tok_mb[:, 1:]
+        per_tok, argmax = chunked_softmax_xent(
+            h[:, :-1], table, tg, bias=None, dtype=h.dtype
+        )
+        correct = (argmax == tg).sum().astype(jnp.float32)
+        return per_tok.mean(), {"correct": correct}
+
+    return last_fn, targets
 
 
 def _run_moe_stacked_1f1b(mod, params, x, last, block):
@@ -447,6 +533,7 @@ class StackedDecoder(nn.Module):
     remat: bool = False
     pipe_axis: Optional[str] = None  # mesh axis for pipeline stages
     pipe_microbatches: int = 0  # 0 = auto (largest k*pipe <= 4*pipe | batch)
+    pipe_virtual: int = 1  # interleaved virtual chunks per stage (1f1b)
     seq_axis: Optional[str] = None  # SP inside the stages (SP x PP)
     sp_mode: str = "ring"  # "ring" | "ulysses"
     moe_experts: int = 0  # >0: MoE MLP on EVERY block (gelu experts)
@@ -624,6 +711,7 @@ class StackedLlamaDecoder(nn.Module):
     remat: bool = False
     pipe_axis: Optional[str] = None
     pipe_microbatches: int = 0
+    pipe_virtual: int = 1  # interleaved virtual chunks per stage (1f1b)
     seq_axis: Optional[str] = None  # SP inside the stages (SP x PP)
     sp_mode: str = "ulysses"  # "ring" | "ulysses" (llama family default)
     moe_experts: int = 0  # >0: Mixtral-style SwiGLU-expert MoE, EVERY block

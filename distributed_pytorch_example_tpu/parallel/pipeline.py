@@ -366,20 +366,49 @@ def stack_stage_params(per_stage_params: Sequence[Any]) -> Any:
 # weights.
 
 
-def one_f_one_b_cycles(n_micro: int, n_stages: int) -> int:
-    """Total schedule cycles: m forwards + warmup/drain + dx-ring tail."""
-    return n_micro + 3 * (n_stages - 1)
+def one_f_one_b_cycles(n_micro: int, n_stages: int,
+                       n_virtual: int = 1) -> int:
+    """Total schedule cycles (chunk-granularity when ``n_virtual > 1``).
+
+    Wave formulation (see the interleaving note in the module comment):
+    microbatches run in waves of ``n_stages``; wave w slot r's forward of
+    chunk c fires at cycle ``w*V + r + c`` and its backward at
+    ``w*V + r + 2(V-1) - c`` where ``V = n_stages * n_virtual`` (both maps
+    are conflict-free per device). The last backward (wave W-1, slot S-1,
+    chunk 0) lands at ``(W-1)V + S-1 + 2(V-1)``; the dx delivery ring adds
+    ``S-1`` more. At ``n_virtual=1`` this reduces exactly to the classic
+    ``n_micro + 3(n_stages-1)``.
+    """
+    if n_micro % n_stages:
+        raise ValueError(
+            f"n_micro {n_micro} not divisible by n_stages {n_stages} — the "
+            "wave schedule (and one_f_one_b itself) requires whole waves"
+        )
+    V = n_stages * n_virtual
+    waves = n_micro // n_stages
+    return (waves - 1) * V + 2 * n_stages + 2 * V - 3
 
 
-def one_f_one_b_stash_slots(n_stages: int) -> int:
-    """Stage-input stash ring size: the in-flight window ``u_F - u_B`` is
-    ``2(S-1-s)`` at stage s, maximal at stage 0 — one live slot more."""
-    return 2 * (n_stages - 1) + 1
+def one_f_one_b_stash_slots(n_stages: int, n_virtual: int = 1) -> int:
+    """Stage-input stash ring size: the F->B age of chunk c's input is
+    ``2(V-1-c)`` cycles, maximal at chunk 0 — one live slot more. Grows
+    with ``n_virtual`` (x ~v more in-flight chunk inputs): the interleaved
+    schedule's known memory-for-bubble trade."""
+    return 2 * (n_stages * n_virtual - 1) + 1
 
 
-def one_f_one_b_bubble(n_micro: int, n_stages: int) -> float:
-    """Fraction of cycles that are fill/drain bubble (per sub-tick)."""
-    return 1.0 - n_micro / one_f_one_b_cycles(n_micro, n_stages)
+def one_f_one_b_bubble(n_micro: int, n_stages: int,
+                       n_virtual: int = 1) -> float:
+    """Fraction of cycles that are fill/drain bubble (per sub-tick).
+
+    Each device runs one chunk-forward (+ one chunk-backward) per cycle
+    and owes ``n_micro * n_virtual`` of each; with cycles only ~1/v the
+    length, interleaving shrinks the bubble TIME by ~v while the fraction
+    formula stays comparable.
+    """
+    return 1.0 - (n_micro * n_virtual) / one_f_one_b_cycles(
+        n_micro, n_stages, n_virtual
+    )
 
 
 def _tree_where(pred, a, b):
@@ -400,7 +429,7 @@ def _zeros_of(struct):
 
 def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
                 stage_fn: StageFn, last_fn, axis_name: str, n_micro: int,
-                aux_desc):
+                aux_desc, seq_axis=None, n_virtual: int = 1):
     """Per-device 1F1B program; call under shard_map (manual on pipe).
 
     in_buf: (m_s, microbatch, ...) — this stage's shard of the input queue
@@ -408,23 +437,70 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
     microbatch c at cycle c). last_args: (n_micro, ...) per-microbatch
     arguments for ``last_fn`` (e.g. target tokens), replicated over pipe.
 
+    ``seq_axis`` — SP x PP x 1F1B: the shard_map is ALSO manual over this
+    axis; activations/last_args arrive sequence-chunked (``stage_fn`` runs
+    the chunk-local ring/Ulysses collectives itself, ``last_fn`` must be
+    chunk-local — see one_f_one_b). Stage/tail params are replicated over
+    seq, so their per-chunk partial gradients (and the chunk-partial
+    loss/metric sums) are psum'd over ``seq_axis`` on the way out.
+
+    ``n_virtual`` — Megatron-style interleaved schedule: each device owns
+    ``v`` non-contiguous model chunks (chunk ``c = j*S + d`` on device
+    ``d``, ``stage_params`` leaves ``(1, v, layers/chunk, ...)`` locally);
+    microbatches run in WAVES of S. Closed-form conflict-free cycle maps
+    (wave w, slot r in [0,S), chunk c, V = S*v):
+
+      forward  of (w, r, c) at cycle  w*V + r + c
+      backward of (w, r, c) at cycle  w*V + r + 2(V-1) - c
+
+    Per device+cycle both maps select at most one chunk each — invert via
+    ``(t - d) mod V`` (forward) / ``(t + d - 2(V-1))`` decomposition
+    (backward). Activations/cotangents ride FULL rings (the d = S-1 -> 0
+    wrap carries chunk jS+S-1 -> (j+1)S handoffs); the input queue rotates
+    only on chunk-0 injection cycles (``t mod V < S``). At ``v = 1``
+    every map, ring, and buffer reduces exactly to the classic 1F1B
+    program (same cycle count, same stash ring), so the non-interleaved
+    tests pin this program's degenerate case. The trade (see
+    one_f_one_b_stash_slots): bubble TIME shrinks ~v, input stash grows
+    ~v, activation ring traffic grows ~v, and every device still pays one
+    ``last_fn`` eval per cycle (now ~v times more cycles of ~1/v the
+    stage work) — pick v so layers/chunk stays >> the head cost.
+
     Returns (loss_sum, metric_sums, aux_sums, d_stage(1, ...), d_last,
-    dx_buf) — loss/metrics/aux psum'd over pipe; d_stage/dx stay sharded.
+    dx_buf) — loss/metrics/aux psum'd over pipe (and seq); d_stage/dx stay
+    sharded over pipe (d_stage seq-reduced, dx seq-chunked).
     """
     n_stages = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     is_last = stage == n_stages - 1
     is_first = stage == 0
     m_s = in_buf.shape[0]
-    K = one_f_one_b_stash_slots(n_stages)
-    n_cycles = one_f_one_b_cycles(n_micro, n_stages)
-    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    V = n_stages * n_virtual
+    K = one_f_one_b_stash_slots(n_stages, n_virtual)
+    n_cycles = one_f_one_b_cycles(n_micro, n_stages, n_virtual)
+    # v=1: chunks is THE stage's params (layers, ...); v>1: (v, layers/chunk,
+    # ...) with the device's j-th virtual chunk selected per cycle
+    chunks = jax.tree_util.tree_map(lambda p: p[0], stage_params)
     # last_params arrive pipe-UNVARYING (replicated); differentiating a
     # varying loss wrt an unvarying value makes the transpose psum the
     # cotangent over pipe — which would fold other stages' masked-out
     # garbage evaluations into every dlast_u. Stamp them varying so grads
-    # stay per-device until the explicit masked psum at the end.
+    # stay per-device until the explicit masked psum at the end. Under
+    # seq_axis the same applies to the STAGE params on the seq axis (they
+    # arrive seq-unvarying): without the stamp every per-cycle vjp would
+    # auto-psum its cotangent over seq — double-counting against the end
+    # psum AND paying a collective per cycle instead of one at the end.
+    chunks = pvary_like(chunks, in_buf, (axis_name,))
     last_params = pvary_like(last_params, in_buf, (axis_name,))
+
+    if n_virtual == 1:
+        pick = lambda j: chunks
+    else:
+        def pick(j):
+            return jax.tree_util.tree_map(
+                lambda p: lax.dynamic_index_in_dim(p, j, 0, keepdims=False),
+                chunks,
+            )
 
     if aux_desc is None:
         aux_zero = aux_weights = None
@@ -437,10 +513,13 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
             (axis_name,),
         )
 
-    shift_up = [(i, i + 1) for i in range(n_stages - 1)]  # activations
-    shift_down = [(i + 1, i) for i in range(n_stages - 1)]  # cotangents
-    ring_down = [(i, (i - 1) % n_stages) for i in range(n_stages)]  # queue
-    ring_up = [(i, (i + 1) % n_stages) for i in range(n_stages)]  # dx out
+    # FULL rings: the wrap links carry the interleaved chunk handoffs
+    # (chunk jS+S-1 on device S-1 -> chunk (j+1)S on device 0 for
+    # activations, and the reverse for cotangents); at v=1 the wrapped
+    # values are never consumed (chunk-0 reads the queue, chunk V-1 seeds
+    # from dy) so the classic schedule is unchanged.
+    ring_down = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    ring_up = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     mb_shape, mb_dtype = in_buf.shape[1:], in_buf.dtype
 
@@ -460,30 +539,45 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
         last_loss, y_proto, last_params, slice_args(jnp.int32(0))
     )
 
-    def cycle(carry, c):
+    def cycle(carry, t):
         (incoming, cot_in, in_buf, stash, dx_buf, reg_dx, reg_du,
          d_stage, d_last, loss_acc, mets_acc, aux_acc) = carry
 
-        # ---- F sub-tick: forward microbatch u_f ----
-        u_f = c - stage
+        # ---- F sub-tick: invert t = w*V + r + j*S + stage ----
+        phase = t - stage
+        pm = jnp.mod(phase, V)
+        w_f = (phase - pm) // V
+        r_f = jnp.mod(pm, n_stages)
+        j_f = pm // n_stages
+        u_f = w_f * n_stages + r_f
         active_f = (u_f >= 0) & (u_f < n_micro)
-        head_slot = c % m_s
+        first_chunk_f = is_first & (j_f == 0)
+        last_chunk_f = is_last & (j_f == n_virtual - 1)
+
+        # input queue: rotates one microbatch toward stage 0 per chunk-0
+        # injection cycle (t mod V < S; at v=1 that is every cycle), so
+        # device 0's head holds microbatch inj(t) whenever it runs a
+        # chunk-0 forward
+        rot = jnp.mod(t, V) < n_stages
+        inj = n_stages * (t // V) + jnp.minimum(jnp.mod(t, V), n_stages)
+        head_slot = jnp.mod(inj, m_s)
         head = lax.dynamic_index_in_dim(in_buf, head_slot, 0, keepdims=False)
-        x_in = jnp.where(is_first, head, incoming)
-        stash = _store(stash, x_in, u_f % K, active_f)
+        x_in = jnp.where(first_chunk_f, head, incoming)
+        stash = _store(stash, x_in, jnp.mod(t, K), active_f)
+        params_f = pick(j_f)
         if aux_desc is None:
-            y = stage_fn(params, x_in)
+            y = stage_fn(params_f, x_in)
         else:
-            y, aux_tick = stage_fn(params, x_in)
+            y, aux_tick = stage_fn(params_f, x_in)
             aux_acc = _tree_add(
                 aux_acc, _tree_where(active_f, aux_tick, aux_zero)
             )
 
-        # last stage: per-microbatch loss, metrics, and the backward seed
+        # last chunk: per-microbatch loss, metrics, and the backward seed
         (loss_u, mets_u), (dy_u, dlast_u) = jax.value_and_grad(
             last_loss, argnums=(0, 1), has_aux=True
         )(y, last_params, slice_args(u_f))
-        keep = is_last & active_f
+        keep = last_chunk_f & active_f
         loss_acc = loss_acc + jnp.where(keep, loss_u, 0.0)
         mets_acc = _tree_add(
             mets_acc, _tree_where(keep, mets_u, _zeros_of(mets_struct))
@@ -496,38 +590,62 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
             ),
         )
 
-        # ---- B sub-tick: backward microbatch u_b (recompute from stash) --
-        u_b = c - 2 * (n_stages - 1) + stage
+        # ---- B sub-tick: invert t = w*V + r + 2(V-1) - (j*S + stage) ----
+        q = t + stage - 2 * (V - 1)
+        r_b = jnp.mod(q, n_stages)
+        s2 = (q - r_b) // n_stages  # = w*v - j
+        j_b = jnp.mod(-s2, n_virtual)
+        w_b = (s2 + j_b) // n_virtual
+        u_b = w_b * n_stages + r_b
         active_b = (u_b >= 0) & (u_b < n_micro)
+        c_b = j_b * n_stages + stage
+        first_chunk_b = is_first & (j_b == 0)
+        last_chunk_b = is_last & (j_b == n_virtual - 1)
+        # this B's matching F ran 2(V-1-c_b) cycles ago (same-cycle for
+        # chunk V-1, whose dy seed is the one just computed above)
         x_saved = lax.dynamic_index_in_dim(
-            stash, jnp.clip(u_b, 0, n_micro - 1) % K, 0, keepdims=False
+            stash, jnp.mod(t - 2 * (V - 1) + 2 * c_b, K), 0, keepdims=False
         )
-        cot = jnp.where(is_last, dy_u, cot_in)
+        cot = jnp.where(last_chunk_b, dy_u, cot_in)
+        params_b = pick(j_b)
         if aux_desc is None:
-            _, vjp_fn = jax.vjp(stage_fn, params, x_saved)
+            _, vjp_fn = jax.vjp(stage_fn, params_b, x_saved)
             dparams_u, dx_u = vjp_fn(cot)
         else:
-            (_, aux_primal), vjp_fn = jax.vjp(stage_fn, params, x_saved)
+            (_, aux_primal), vjp_fn = jax.vjp(stage_fn, params_b, x_saved)
             # each weight seed must carry exactly its aux output's
             # varying-manual-axes type (a constant aux stays unvarying)
             aux_ct = jax.tree_util.tree_map(
                 lambda w, a: pvary_like(w, a, ()), aux_weights, aux_primal
             )
             dparams_u, dx_u = vjp_fn((cot, aux_ct))
-        d_stage = _tree_add(
-            d_stage,
-            _tree_where(
-                active_b, dparams_u,
-                jax.tree_util.tree_map(jnp.zeros_like, dparams_u),
-            ),
-        )
+        if n_virtual == 1:
+            d_stage = _tree_add(
+                d_stage,
+                _tree_where(
+                    active_b, dparams_u,
+                    jax.tree_util.tree_map(jnp.zeros_like, dparams_u),
+                ),
+            )
+        else:
+            d_stage = jax.tree_util.tree_map(
+                lambda acc, g: lax.dynamic_update_index_in_dim(
+                    acc,
+                    lax.dynamic_index_in_dim(acc, j_b, 0, keepdims=False)
+                    + jnp.where(active_b, g, jnp.zeros_like(g)),
+                    j_b, 0,
+                ),
+                d_stage, dparams_u,
+            )
 
-        # stage 0's dx is final: self-store its own block, ring the rest up
-        dx_final = is_first & active_b
+        # chunk 0's dx (device 0) is final: self-store its own block, ring
+        # the rest up; on j_b>0 cycles device 0 relays like everyone else
+        # (stale wrapped entries re-store idempotently at their owner)
+        dx_final = first_chunk_b & active_b
         dx_buf = _store(dx_buf, dx_u, u_b % m_s, dx_final & (u_b // m_s == 0))
-        send_dx = jnp.where(is_first, dx_u, reg_dx)
+        send_dx = jnp.where(first_chunk_b, dx_u, reg_dx)
         send_du = jnp.where(
-            is_first, jnp.where(active_b, u_b, -1), reg_du
+            first_chunk_b, jnp.where(active_b, u_b, -1), reg_du
         )
         reg_dx = lax.ppermute(send_dx, axis_name, ring_up)
         reg_du = lax.ppermute(send_du, axis_name, ring_up)
@@ -536,14 +654,28 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
             (reg_du >= 0) & (reg_du // m_s == stage) & ~is_first,
         )
 
-        # ---- neighbor comms for the next cycle ----
+        # ---- ring comms for the next cycle ----
         if n_stages > 1:
-            incoming = lax.ppermute(y, axis_name, shift_up)
-            cot_in = lax.ppermute(dx_u, axis_name, shift_down)
-        received = lax.ppermute(head, axis_name, ring_down)
-        in_buf = lax.dynamic_update_index_in_dim(
-            in_buf, received, head_slot, 0
-        )
+            incoming = lax.ppermute(y, axis_name, ring_up)
+            cot_in = lax.ppermute(dx_u, axis_name, ring_down)
+        if n_virtual == 1:
+            # every cycle rotates (rot is constant True): classic path
+            received = lax.ppermute(head, axis_name, ring_down)
+            in_buf = lax.dynamic_update_index_in_dim(
+                in_buf, received, head_slot, 0
+            )
+        else:
+            # only S of every V cycles rotate; skip the microbatch-sized
+            # ring transfer on the others. ``rot`` depends only on the
+            # cycle counter t, so every device takes the same branch and
+            # the ppermute inside the cond cannot mismatch.
+            def _rotate(buf):
+                received = lax.ppermute(head, axis_name, ring_down)
+                return lax.dynamic_update_index_in_dim(
+                    buf, received, head_slot, 0
+                )
+
+            in_buf = lax.cond(rot, _rotate, lambda buf: buf, in_buf)
         return (incoming, cot_in, in_buf, stash, dx_buf, reg_dx, reg_du,
                 d_stage, d_last, loss_acc, mets_acc, aux_acc), None
 
@@ -558,7 +690,7 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
         pv(jnp.zeros_like(in_buf)),                 # dx out queue
         pv(jnp.zeros(mb_shape, mb_dtype)),          # dx ring register
         pv(jnp.full((), -1, jnp.int32)),            # dx ring mb index
-        pv(jax.tree_util.tree_map(jnp.zeros_like, params)),      # d_stage
+        pv(jax.tree_util.tree_map(jnp.zeros_like, chunks)),      # d_stage
         pv(jax.tree_util.tree_map(jnp.zeros_like, last_params)),  # d_last
         pv(jnp.zeros((), jnp.float32)),             # loss sum
         pv(_zeros_of(mets_struct)),                 # metric sums
@@ -567,9 +699,17 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
     (_, _, _, _, dx_buf, _, _, d_stage, d_last, loss_acc, mets_acc,
      aux_acc) = lax.scan(cycle, carry0, jnp.arange(n_cycles))[0]
 
+    # loss/metrics/aux/d_last sum over pipe (masked to last-stage entries)
+    # AND over seq chunks; d_stage stays pipe-sharded but each seq peer
+    # holds only its chunk's partial — reduce over seq only.
+    axes = (axis_name,) if seq_axis is None else (axis_name, seq_axis)
     psum = lambda t: jax.tree_util.tree_map(
-        lambda a: lax.psum(a, axis_name), t
+        lambda a: lax.psum(a, axes), t
     )
+    if seq_axis is not None:
+        d_stage = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, seq_axis), d_stage
+        )
     aux_out = psum(aux_acc) if aux_desc is not None else {}
     return (
         psum(loss_acc), psum(mets_acc), aux_out,
@@ -579,7 +719,8 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
 
 
 def _1f1b_run(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
-              aux_desc, stage_params, last_params, x_stack, last_args):
+              aux_desc, seq, n_virtual, stage_params, last_params, x_stack,
+              last_args):
     """Trace the 1F1B shard_map; returns outputs AND gradients."""
     mets_struct = jax.eval_shape(
         lambda lp, y, a: last_fn(lp, y, a)[1],
@@ -593,17 +734,27 @@ def _1f1b_run(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
         aux_desc[0].unflatten(list(aux_desc[1]))
         if aux_desc is not None else {}
     )
+    # SP x PP: the queue is (n_micro, mb, S, ...) — dim 2 manual over seq;
+    # last_args leaves with a sequence dim (rank >= 3: (n_micro, mb, S...))
+    # are chunked the same way, scalar-per-microbatch leaves replicate.
+    x_spec = P(pipe_axis) if seq is None else P(pipe_axis, None, seq)
+    arg_spec = (
+        (lambda a: P())
+        if seq is None
+        else (lambda a: P(None, None, seq) if a.ndim >= 3 else P())
+    )
     fn = jax.shard_map(
         functools.partial(
             _1f1b_local, stage_fn=stage_fn, last_fn=last_fn,
             axis_name=pipe_axis, n_micro=n_micro, aux_desc=aux_desc,
+            seq_axis=seq, n_virtual=n_virtual,
         ),
         mesh=mesh,
         in_specs=(
             jax.tree_util.tree_map(lambda _: P(pipe_axis), stage_params),
             jax.tree_util.tree_map(lambda _: P(), last_params),
-            P(pipe_axis),
-            jax.tree_util.tree_map(lambda _: P(), last_args),
+            x_spec,
+            jax.tree_util.tree_map(arg_spec, last_args),
         ),
         out_specs=(
             P(),
@@ -611,28 +762,31 @@ def _1f1b_run(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
             jax.tree_util.tree_map(lambda _: P(), aux_struct),
             jax.tree_util.tree_map(lambda _: P(pipe_axis), stage_params),
             jax.tree_util.tree_map(lambda _: P(), last_params),
-            P(pipe_axis),
+            x_spec,
         ),
-        axis_names={pipe_axis},
+        axis_names={pipe_axis} | ({seq} if seq else set()),
     )
     return fn(stage_params, last_params, x_stack, last_args)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
 def _1f1b_loss(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
-               aux_desc, stage_params, last_params, x_stack, last_args):
+               aux_desc, seq, n_virtual, stage_params, last_params, x_stack,
+               last_args):
     loss, mets, aux, _, _, _ = _1f1b_run(
         stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes, aux_desc,
-        stage_params, last_params, x_stack, last_args,
+        seq, n_virtual, stage_params, last_params, x_stack, last_args,
     )
     return loss, mets, aux
 
 
 def _1f1b_loss_fwd(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
-                   aux_desc, stage_params, last_params, x_stack, last_args):
+                   aux_desc, seq, n_virtual, stage_params, last_params,
+                   x_stack, last_args):
     loss, mets, aux, d_stage, d_last, dx = _1f1b_run(
         stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes, aux_desc,
-        stage_params, last_params, x_stack, last_args,
+        seq, n_virtual, stage_params, last_params, x_stack, last_args,
     )
     int_args = jax.tree_util.tree_map(
         lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), last_args
@@ -641,7 +795,7 @@ def _1f1b_loss_fwd(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
 
 
 def _1f1b_loss_bwd(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
-                   aux_desc, res, cts):
+                   aux_desc, seq, n_virtual, res, cts):
     import numpy as np
 
     d_stage, d_last, dx, int_args = res
@@ -676,6 +830,8 @@ def one_f_one_b(
     pipe_axis: str = "pipe",
     batch_axes: Sequence[str] = ("data", "fsdp"),
     aux_weights: Any = None,
+    seq_axis: Optional[str] = None,
+    n_virtual: int = 1,
 ) -> tuple:
     """1F1B pipeline train pass: per-microbatch loss computed at the last
     stage, backward interleaved one cycle behind forward.
@@ -707,6 +863,19 @@ def one_f_one_b(
         (mean loss + weighted mean aux, the trainer's convention) gets
         exactly the right gradients, while any OTHER outer scaling of the
         aux terms is silently ignored.
+      seq_axis: SP x PP x 1F1B — when the mesh spans this axis, the
+        schedule's shard_map goes manual over {pipe, seq} (the GPipe
+        ``seq_axis`` contract, same no-nested-shard_map rationale):
+        ``stage_fn`` sees SEQUENCE-LOCAL chunks (dim 2 sharded) and runs
+        the chunk-local SP collectives itself, and ``last_fn`` must be
+        CHUNK-LOCAL: called on a sequence shard of one microbatch's final
+        activations with the same shard of every rank >= 3 ``last_args``
+        leaf (rank < 3 leaves replicate), returning this chunk's loss/
+        metric partial sums — the schedule psums them over seq. For a
+        causal-LM loss that means pre-shifted targets plus a validity
+        mask instead of an in-``last_fn`` shift (the shift would cross
+        chunk boundaries). Chunk-local ``jax.value_and_grad`` seeds are
+        exact because softmax-CE is position-local.
 
     Returns ``(loss_sum, metric_sums, aux_sums)``, differentiable wrt
     (stage_params, last_params, x).
@@ -719,10 +888,21 @@ def one_f_one_b(
         raise ValueError(
             f"n_micro {n_micro} not divisible by pipe size {n_stages}"
         )
+    seq = seq_axis if (seq_axis and mesh.shape.get(seq_axis, 1) > 1) else None
+    if seq is not None and x.ndim < 3:
+        raise ValueError(
+            f"seq_axis={seq!r} needs (batch, seq, ...) activations, got "
+            f"rank {x.ndim}"
+        )
+    if seq is not None and aux_weights is not None:
+        raise NotImplementedError(
+            "aux accumulation (MoE) does not compose with seq_axis inside "
+            "the pipeline; drop one (the models reject PP x SP x EP)"
+        )
     x_stack = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
     data = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
     x_stack = lax.with_sharding_constraint(
-        x_stack, NamedSharding(mesh, P(pipe_axis, data or None))
+        x_stack, NamedSharding(mesh, P(pipe_axis, data or None, seq))
     )
     mb = batch // n_micro
     last_args = jax.tree_util.tree_map(
@@ -738,6 +918,6 @@ def one_f_one_b(
             raise TypeError("aux_weights must be python floats (static)")
         aux_desc = (treedef, tuple(float(w) for w in leaves))
     return _1f1b_loss(
-        stage_fn, last_fn, mesh, n_micro, pipe_axis, data, aux_desc,
-        stage_params, last_params, x_stack, last_args,
+        stage_fn, last_fn, mesh, n_micro, pipe_axis, data, aux_desc, seq,
+        n_virtual, stage_params, last_params, x_stack, last_args,
     )

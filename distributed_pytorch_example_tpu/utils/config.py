@@ -77,7 +77,11 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         "backward) or 1f1b (interleaved; activation stash "
                         "~n_stages instead of ~n_micro — the depth "
                         "scaling schedule; gpt2/llama causal LM incl. "
-                        "MoE; SP x PP stays on gpipe)")
+                        "MoE and SP)")
+    parser.add_argument("--pipe-virtual", type=int, default=1,
+                        help="interleaved virtual chunks per pipeline stage "
+                        "(Megatron-style; needs --pipe-schedule 1f1b; "
+                        "bubble time ~/v for ~v x input-stash memory)")
     parser.add_argument("--pad-token-id", type=int, default=None,
                         help="bert: mask keys at this token id out of "
                         "attention (padding); default: no padding mask")

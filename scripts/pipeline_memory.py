@@ -44,7 +44,7 @@ import numpy as np  # noqa: E402
 MB = 1024 * 1024
 
 
-def build(schedule: str, n_micro: int, remat: bool):
+def build(schedule: str, n_micro: int, remat: bool, n_virtual: int = 1):
     from distributed_pytorch_example_tpu.models.gpt2 import GPT2
     from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
 
@@ -52,16 +52,16 @@ def build(schedule: str, n_micro: int, remat: bool):
         vocab_size=512, max_len=256, model_dim=256, num_layers=8,
         num_heads=8, mlp_dim=1024, pipe_axis="pipe",
         pipe_microbatches=n_micro, pipe_schedule=schedule, remat=remat,
-        logits_mode="hidden",
+        pipe_virtual=n_virtual, logits_mode="hidden",
     ), CausalLMTask()
 
 
 def measure(schedule: str, n_micro: int, mb_size: int, seq: int,
-            remat: bool = False) -> dict:
+            remat: bool = False, n_virtual: int = 1) -> dict:
     from distributed_pytorch_example_tpu.runtime import MeshSpec, make_mesh
 
     mesh = make_mesh(MeshSpec(data=2, pipe=4))
-    model, task = build(schedule, n_micro, remat)
+    model, task = build(schedule, n_micro, remat, n_virtual)
     batch = mb_size * n_micro
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, 512, size=(batch, seq)),
@@ -79,7 +79,8 @@ def measure(schedule: str, n_micro: int, mb_size: int, seq: int,
         lowered = jax.jit(jax.value_and_grad(loss_fn)).lower(params, tokens)
         stats = lowered.compile().memory_analysis()
     return {
-        "schedule": schedule + ("+remat" if remat else ""),
+        "schedule": schedule + ("+remat" if remat else "")
+        + (f"+v{n_virtual}" if n_virtual > 1 else ""),
         "n_micro": n_micro,
         "batch": batch,
         "temp_mb": round(stats.temp_size_in_bytes / MB, 2),
@@ -98,10 +99,11 @@ def main() -> int:
 
     micros = [int(m) for m in args.micros.split(",")]
     rows = []
-    for schedule, remat in (("gpipe", False), ("gpipe", True),
-                            ("1f1b", False)):
+    for schedule, remat, v in (("gpipe", False, 1), ("gpipe", True, 1),
+                               ("1f1b", False, 1), ("1f1b", False, 2)):
         for m in micros:
-            row = measure(schedule, m, args.mb_size, args.seq, remat=remat)
+            row = measure(schedule, m, args.mb_size, args.seq, remat=remat,
+                          n_virtual=v)
             rows.append(row)
             print(json.dumps(row), flush=True)
 
@@ -113,11 +115,31 @@ def main() -> int:
         return (sel[-1]["temp_mb"] - sel[0]["temp_mb"]) / (
             sel[-1]["n_micro"] - sel[0]["n_micro"])
 
+    # interleaving's trade, both sides as numbers: the stash-memory cost
+    # is MEASURED (temp at fixed m, v=2 vs v=1) and the bubble win is the
+    # pinned schedule formula in stage-equivalent time units (cycles are
+    # chunk-granular, each ~1/v of a stage)
+    from distributed_pytorch_example_tpu.parallel.pipeline import (
+        one_f_one_b_cycles,
+    )
+
+    def temp(name, m):
+        return next(r["temp_mb"] for r in rows
+                    if r["schedule"] == name and r["n_micro"] == m)
+
+    m_ref = micros[-1]
     summary = {
         "temp_mb_per_extra_microbatch": {
             "gpipe": round(slope("gpipe", False), 3),
             "gpipe+remat": round(slope("gpipe", True), 3),
             "1f1b": round(slope("1f1b", False), 3),
+        },
+        "interleaved_v2": {
+            "temp_mb_v1": temp("1f1b", m_ref),
+            "temp_mb_v2": temp("1f1b+v2", m_ref),
+            "stage_equiv_cycles_v1": one_f_one_b_cycles(m_ref, 4, 1),
+            "stage_equiv_cycles_v2": one_f_one_b_cycles(m_ref, 4, 2) / 2,
+            "n_micro": m_ref,
         },
         "config": {"mb_size": args.mb_size, "seq": args.seq,
                    "mesh": "data=2 x pipe=4", "model": "gpt2 256d x 8L"},

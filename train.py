@@ -251,8 +251,16 @@ def main():
         overrides["pipe_microbatches"] = args.pipe_microbatches
         if args.pipe_schedule != "gpipe":
             overrides["pipe_schedule"] = args.pipe_schedule
+        if args.pipe_virtual > 1:
+            if args.pipe_schedule != "1f1b":
+                parser.error("--pipe-virtual needs --pipe-schedule 1f1b "
+                             "(interleaving is a 1F1B refinement)")
+            overrides["pipe_virtual"] = args.pipe_virtual
     elif args.pipe_schedule != "gpipe":
         parser.error("--pipe-schedule 1f1b needs --mesh-pipe > 1")
+    elif args.pipe_virtual > 1:
+        parser.error("--pipe-virtual needs --mesh-pipe > 1 and "
+                     "--pipe-schedule 1f1b")
     model = dpx.models.get_model(args.model, **overrides)
     task = build_task(args, model)
 
