@@ -255,17 +255,20 @@ def test_1f1b_matches_sequential(devices):
         )
 
 
-def test_1f1b_interleaved_matches_sequential(devices):
-    """Interleaved (Megatron-style virtual-chunk) 1F1B: n_virtual=2 on a
-    2-stage mesh = 4 model chunks, device d holding chunks {d, d+2}. Loss,
-    metrics, and ALL grads (chunk params in the interleaved (S, v, ...)
-    layout, head params, input) match the microbatched sequential
-    reference running the chunks in order 0..V-1."""
+@pytest.mark.parametrize("S,v", [(2, 2), (4, 2)])
+def test_1f1b_interleaved_matches_sequential(devices, S, v):
+    """Interleaved (Megatron-style virtual-chunk) 1F1B: v chunks per
+    device (device d holds chunks {d, d+S, ...}). Loss, metrics, and ALL
+    grads (chunk params in the interleaved (S, v, ...) layout, head
+    params, input) match the microbatched sequential reference running
+    the chunks in order 0..V-1. The 4-stage case exercises the full-ring
+    wraps through middle devices (activation chunk jS+S-1 -> (j+1)S,
+    cotangent wrap, stale dx-ring relays through device 0)."""
     from distributed_pytorch_example_tpu.parallel.pipeline import one_f_one_b
 
-    S, v, m, dim, n_cls = 2, 2, 8, 16, 5
+    m, dim, n_cls = 8, 16, 5
     V = S * v
-    mesh = make_mesh(MeshSpec(data=4, pipe=S))
+    mesh = make_mesh(MeshSpec(data=8 // S, pipe=S))
     block, per_chunk, stacked_V, stage_fn = make_stages(V, dim=dim)
     # interleaved layout: leaf[(d, j)] = chunk j*S + d
     interleaved = jax.tree_util.tree_map(
