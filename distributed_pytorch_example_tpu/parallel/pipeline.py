@@ -464,7 +464,14 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
     one_f_one_b_stash_slots): bubble TIME shrinks ~v, input stash grows
     ~v, activation ring traffic grows ~v, and every device still pays one
     ``last_fn`` eval per cycle (now ~v times more cycles of ~1/v the
-    stage work) — pick v so layers/chunk stays >> the head cost.
+    stage work) — pick v so layers/chunk stays >> the head cost. Param
+    placement: the strided assignment (layer l on device (l//Lc) mod S)
+    is not expressible as a dim-0 NamedSharding over the logical layer
+    order, so with the partitioner's contiguous pipe blocks GSPMD inserts
+    ONE param-tree reshard per step ahead of the schedule — amortized
+    over all microbatches, and measured in scripts/pipeline_memory.py
+    (the v=2 rows carry it); storing master params chunk-permuted would
+    remove it at the cost of placement-dependent checkpoints.
 
     Returns (loss_sum, metric_sums, aux_sums, d_stage(1, ...), d_last,
     dx_buf) — loss/metrics/aux psum'd over pipe (and seq); d_stage/dx stay

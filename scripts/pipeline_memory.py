@@ -58,6 +58,9 @@ def build(schedule: str, n_micro: int, remat: bool, n_virtual: int = 1):
 
 def measure(schedule: str, n_micro: int, mb_size: int, seq: int,
             remat: bool = False, n_virtual: int = 1) -> dict:
+    from distributed_pytorch_example_tpu.parallel.partition import (
+        transformer_partitioner,
+    )
     from distributed_pytorch_example_tpu.runtime import MeshSpec, make_mesh
 
     mesh = make_mesh(MeshSpec(data=2, pipe=4))
@@ -69,6 +72,14 @@ def measure(schedule: str, n_micro: int, mb_size: int, seq: int,
     )
     with mesh:
         params = model.init(jax.random.key(0), tokens, train=False)["params"]
+        # pin the PRODUCTION param shardings (contiguous dim-0 pipe blocks,
+        # the Trainer's partitioner) so schedules are compared under the
+        # same interface placement. Under pipe_virtual>1 this includes the
+        # per-step strided param reshard the interleaved placement needs
+        # (layer l lives on device (l//Lc) mod S, which no dim-0
+        # NamedSharding over logical layer order can express) — that cost
+        # belongs in the measurement.
+        params = transformer_partitioner(mesh).shard_tree(params)
 
         def loss_fn(p, tok):
             loss, _, _ = task.compute_loss(
@@ -76,7 +87,16 @@ def measure(schedule: str, n_micro: int, mb_size: int, seq: int,
             )
             return loss
 
-        lowered = jax.jit(jax.value_and_grad(loss_fn)).lower(params, tokens)
+        # pin grad out-shardings to the param shardings (what the Trainer
+        # effectively does by feeding grads to the sharded optimizer update
+        # inside the same jit) — without this XLA may replicate the grads
+        # at the interface under pipe_virtual>1, polluting out_mb
+        out_sh = (
+            jax.tree_util.tree_map(lambda x: x.sharding, params)
+        )
+        lowered = jax.jit(
+            jax.value_and_grad(loss_fn), out_shardings=(None, out_sh)
+        ).lower(params, tokens)
         stats = lowered.compile().memory_analysis()
     return {
         "schedule": schedule + ("+remat" if remat else "")
