@@ -44,6 +44,26 @@ from jax import lax
 
 DEFAULT_BLOCK = 4096
 
+# Unrolled blocks have NO data dependence between their (N, Vb) logits
+# matmuls (only the scalar running reductions chain), so XLA's scheduler
+# may compute MANY blocks concurrently — at 64k tokens that is 13 x 1 GB
+# f32 logit blocks live at once and an HBM OOM (measured: 22.8 G needed
+# on the 16 G chip). When the all-blocks-concurrent worst case (N x V f32
+# — the guard must key on the TOTAL, or shrinking block_size re-creates
+# the same many-small-blocks schedule) exceeds _SERIALIZE_TOTAL_BYTES,
+# the loops thread an optimization_barrier through the carries so block
+# k+1's matmul cannot start before block k is consumed, and blocks wider
+# than _SERIALIZE_BLOCK_BYTES also shrink (XLA's remat pass clones a few
+# matmuls outside any barrier chain; small blocks bound the clones too).
+# The budget is deliberately ABOVE bench scale (GPT-2 1024 x batch 16 is
+# 3.3 GB): when memory is rich, XLA CSEs the backward's per-block logits
+# recompute against the forward's logits — a free ~1.2 TFLOP/step win the
+# barriers would forfeit (measured -2.7% tok/s with a 2 GiB budget).
+# Serialization is for where that trade inverts: the memory-bound
+# long-context regime.
+_SERIALIZE_TOTAL_BYTES = 4 * 1024 * 1024 * 1024
+_SERIALIZE_BLOCK_BYTES = 384 * 1024 * 1024
+
 
 def _block_logits(x, e_blk, b_blk, dtype):
     """f32 logits of one vocab block: (N, D) x (Vb, D)^T [+ bias]."""
@@ -67,13 +87,15 @@ def _blocks(vocab: int, block_size: int):
     return spans
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _chunked_xent(x, embedding, bias, targets, block_size, dtype):
-    loss, argmax, _ = _forward(x, embedding, bias, targets, block_size, dtype)
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _chunked_xent(x, embedding, bias, targets, block_size, dtype, serial):
+    loss, argmax, _ = _forward(
+        x, embedding, bias, targets, block_size, dtype, serial
+    )
     return loss, argmax
 
 
-def _forward(x, embedding, bias, targets, block_size, dtype):
+def _forward(x, embedding, bias, targets, block_size, dtype, serial):
     n = x.shape[0]
     vocab = embedding.shape[0]
     m = jnp.full((n,), -jnp.inf, jnp.float32)  # running max
@@ -81,7 +103,13 @@ def _forward(x, embedding, bias, targets, block_size, dtype):
     tl = jnp.zeros((n,), jnp.float32)  # target logit
     best_v = jnp.full((n,), -jnp.inf, jnp.float32)
     best_i = jnp.zeros((n,), jnp.int32)
+    first = True
     for off, width in _blocks(vocab, block_size):
+        if serial and not first:
+            # chain this block's matmul after the previous block's
+            # reductions: bounds live f32 logits at one block
+            x, m = lax.optimization_barrier((x, m))
+        first = False
         e_blk = lax.slice_in_dim(embedding, off, off + width)
         b_blk = None if bias is None else lax.slice_in_dim(bias, off, off + width)
         logits = _block_logits(x, e_blk, b_blk, dtype)  # (N, width) f32
@@ -104,19 +132,27 @@ def _forward(x, embedding, bias, targets, block_size, dtype):
     return lse - tl, best_i, lse
 
 
-def _fwd(x, embedding, bias, targets, block_size, dtype):
-    loss, argmax, lse = _forward(x, embedding, bias, targets, block_size, dtype)
+def _fwd(x, embedding, bias, targets, block_size, dtype, serial):
+    loss, argmax, lse = _forward(
+        x, embedding, bias, targets, block_size, dtype, serial
+    )
     return (loss, argmax), (x, embedding, bias, targets, lse)
 
 
-def _bwd(block_size, dtype, res, g):
+def _bwd(block_size, dtype, serial, res, g):
     x, embedding, bias, targets, lse = res
     g_loss = g[0].astype(jnp.float32)  # argmax output is int: float0, ignored
     vocab = embedding.shape[0]
     dx = jnp.zeros(x.shape, jnp.float32)
     de_blocks = []
     db_blocks = []
+    first = True
     for off, width in _blocks(vocab, block_size):
+        if serial and not first:
+            # backward blocks are fully independent (each reuses the saved
+            # lse) — without the chain XLA schedules them all at once
+            x, dx = lax.optimization_barrier((x, dx))
+        first = False
         e_blk = lax.slice_in_dim(embedding, off, off + width)
         b_blk = None if bias is None else lax.slice_in_dim(bias, off, off + width)
         logits = _block_logits(x, e_blk, b_blk, dtype)  # (N, width) f32
@@ -193,5 +229,27 @@ def chunked_softmax_xent(
         n *= d
     x = hidden.reshape(n, dim).astype(dtype)
     t = targets.reshape(n).astype(jnp.int32)
-    loss, argmax = _chunked_xent(x, embedding, bias, t, int(block_size), dtype)
+    # long-context guard — see the constants' comment: serialize when the
+    # all-blocks-concurrent f32 logits could threaten HBM, and shrink
+    # oversized blocks (lane-aligned, equal FLOPs) so XLA's remat clones
+    # stay small too. ``n`` here is the TRACE-TIME (global) token count;
+    # under GSPMD data parallelism each chip holds only n / dp_size of
+    # it, so the decision uses the per-shard count — otherwise an 8-way
+    # DP run at bench-scale per-chip memory would trip the guard the
+    # budget deliberately keeps off. (The SP x PP chunk-local path calls
+    # this INSIDE shard_map where n is already local and tiny; dividing
+    # again only makes serialization rarer there, which is safe.)
+    from distributed_pytorch_example_tpu.runtime.mesh import (
+        current_mesh,
+        data_parallel_size,
+    )
+
+    mesh = current_mesh()
+    n_shard = n // (data_parallel_size(mesh) if mesh is not None else 1)
+    block = int(block_size)
+    serial = n_shard * embedding.shape[0] * 4 > _SERIALIZE_TOTAL_BYTES
+    if serial and n_shard * block * 4 > _SERIALIZE_BLOCK_BYTES:
+        max_block = _SERIALIZE_BLOCK_BYTES // (4 * max(n_shard, 1))
+        block = max(512, (max_block // 512) * 512)
+    loss, argmax = _chunked_xent(x, embedding, bias, t, block, dtype, serial)
     return loss.reshape(lead), argmax.reshape(lead)

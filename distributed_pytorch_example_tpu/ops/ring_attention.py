@@ -400,7 +400,11 @@ def _ring_fwd(q, k, v, kv_mask, axis_name, causal, scale, flash, interpret):
     out, lse = _ring_fwd_impl(
         q, k, v, kv_mask, axis_name, causal, scale, flash, interpret
     )
-    return out, (q, k, v, kv_mask, out, lse)
+    # compact the (B, S, N, 1) lse for the RESIDUAL: the trailing
+    # singleton tiles T(8, 128) at 128x the bytes (the same pathology
+    # fixed at flash_attention._flash_fwd) — at long local sequence that
+    # is hundreds of padded MB per layer held across the backward
+    return out, (q, k, v, kv_mask, out, lse[..., 0])
 
 
 def _ring_bwd(axis_name, causal, scale, flash, interpret, residuals, g):
@@ -408,8 +412,8 @@ def _ring_bwd(axis_name, causal, scale, flash, interpret, residuals, g):
 
     q, k, v, kv_mask, out, lse = residuals
     dq, dk, dv = _ring_bwd_impl(
-        q, k, v, kv_mask, out, lse, g, axis_name, causal, scale, flash,
-        interpret,
+        q, k, v, kv_mask, out, lse[..., None], g, axis_name, causal, scale,
+        flash, interpret,
     )
     dmask = None
     if kv_mask is not None:

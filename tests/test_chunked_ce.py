@@ -121,3 +121,38 @@ def test_shape_validation():
         chunked_softmax_xent(
             jnp.zeros((2, 3, 9)), embedding, jnp.zeros((2, 4), jnp.int32)
         )
+
+
+def test_serialized_long_context_path_matches(monkeypatch):
+    """The memory-bound serialization path (optimization_barrier threading
+    + block shrink, engaged above _SERIALIZE_TOTAL_BYTES) is numerically
+    identical to the free-scheduling path: loss, argmax, and grads match
+    with the thresholds forced to zero."""
+    from distributed_pytorch_example_tpu.ops import chunked_ce as cc
+
+    rng = np.random.default_rng(0)
+    n, d, v = 64, 32, 517
+    hidden = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    emb = jnp.asarray(rng.standard_normal((v, d)) * 0.1, jnp.float32)
+    tg = jnp.asarray(rng.integers(0, v, size=(n,)), jnp.int32)
+
+    def f(h, e):
+        loss, am = cc.chunked_softmax_xent(
+            h, e, tg, block_size=128, dtype=jnp.float32
+        )
+        return loss.sum(), am
+
+    (l0, am0), g0 = jax.value_and_grad(f, argnums=(0, 1), has_aux=True)(
+        hidden, emb
+    )
+    monkeypatch.setattr(cc, "_SERIALIZE_TOTAL_BYTES", 0)
+    monkeypatch.setattr(cc, "_SERIALIZE_BLOCK_BYTES", 0)
+    (l1, am1), g1 = jax.value_and_grad(f, argnums=(0, 1), has_aux=True)(
+        hidden, emb
+    )
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(am0), np.asarray(am1))
+    for a, b in zip(g0, g1):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6
+        )
