@@ -52,11 +52,22 @@ def _losses(stderr: str) -> dict:
 
 
 def _run(args, timeout=600):
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "train.py"), *args],
-        capture_output=True, text=True, env=_env(), cwd=REPO,
-        timeout=timeout,
-    )
+    # one retry on crash-by-signal BEFORE any training step logged: under
+    # a full-suite run on the 1-core box the spawned interpreter
+    # occasionally SIGABRTs in XLA thread teardown before training starts
+    # (observed once in ~10 suite runs; passes in isolation). The no-Loss
+    # guard keeps the retry from re-running a --resume invocation whose
+    # first attempt already trained past the mid-epoch checkpoint (which
+    # would silently degrade this test to epoch-boundary resume). A real
+    # trainer bug exits nonzero (no retry) or aborts repeatably.
+    for attempt in (0, 1):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "train.py"), *args],
+            capture_output=True, text=True, env=_env(), cwd=REPO,
+            timeout=timeout,
+        )
+        if proc.returncode >= 0 or "Loss:" in proc.stderr or attempt:
+            break
     assert proc.returncode == 0, proc.stderr[-2000:]
     return proc.stderr
 
