@@ -1,0 +1,25 @@
+"""graft-lint: static sharding/collective/numerics auditing.
+
+Three layers, none of which executes a train step:
+
+- :mod:`.collectives` — lower + compile the jitted train step per dryrun
+  mesh config, parse the collectives (kind/count/bytes) out of the
+  compiled HLO, and gate them against committed budgets
+  (``analysis/comm_budgets.json``).
+- :mod:`.shardlint` — walk the step's jaxpr and committed placements:
+  large replicated params the partition rules would shard, off-allowlist
+  bf16→f32 promotions, and donated arguments the executable silently
+  failed to alias.
+- :mod:`.pylint_rules` — repo-specific AST lints over the package
+  sources (host syncs in traced scope, trace-time mesh-size layout
+  guesses, mutable default args in public APIs).
+
+This package intentionally does NOT import jax at import time:
+:mod:`.pylint_rules` and the budget comparison are usable without a
+backend (the jax-heavy entry points import lazily). The CLI wrapper is
+``scripts/graft_lint.py``; the pytest gate is ``tests/test_graft_lint.py``.
+"""
+
+from distributed_pytorch_example_tpu.analysis.findings import Finding
+
+__all__ = ["Finding"]

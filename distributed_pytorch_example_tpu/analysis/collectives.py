@@ -1,0 +1,267 @@
+"""Static collective auditor: compiled-HLO comm budgets per mesh config.
+
+EQuARX (arxiv 2506.17615) and cross-replica sharding (arxiv 2004.13336)
+both locate distributed-training cost in the SHAPE and BYTE VOLUME of the
+collectives XLA emits — which is exactly what silent sharding regressions
+change without failing a single numeric test (an accidentally replicated
+weight turns into an all-gather; a widened layout doubles all-reduce
+bytes). This module pins that surface statically:
+
+1. lower + compile the jitted train step of a dryrun mesh config
+   (``__graft_entry__.build_dryrun_case``) on the fake CPU mesh — no step
+   is executed;
+2. parse ``all-reduce`` / ``all-gather`` / ``reduce-scatter`` /
+   ``all-to-all`` / ``collective-permute`` out of the compiled
+   (post-SPMD-partitioning) HLO with their result shapes;
+3. reduce to ``{kind: {count, bytes}}`` and compare against the committed
+   budgets in ``analysis/comm_budgets.json`` — any count increase, or a
+   byte increase beyond tolerance, is a violation.
+
+Byte volume is the collective's RESULT buffer size — a deliberate,
+consistent proxy (for all-gather it is the gathered size, for
+reduce-scatter the scattered size); the gate cares about deltas, not an
+exact wire-byte model. ``-start``/``-done`` async pairs count once.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from distributed_pytorch_example_tpu.analysis.findings import Finding
+
+COLLECTIVE_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+DEFAULT_BYTE_TOLERANCE = 0.05
+
+DEFAULT_BUDGETS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "comm_budgets.json"
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# `%name = <shape> <op>(...)` — shape is a single typed array or a
+# parenthesized tuple of them (no nested parens in HLO shape syntax)
+_HLO_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.-]+\s*=\s*(\([^)]*\)|[^\s(]+)\s+"
+    r"([a-z][a-z0-9-]*)\("
+)
+_SHAPE_RE = re.compile(r"(\w+)\[([0-9,]*)\]")
+
+
+def _shape_bytes(shape_str: str) -> int:
+    """Total bytes of an HLO result shape string (array or tuple)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            continue  # token[], opaque[]: not data volume
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def parse_collectives(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """``{kind: {count, bytes}}`` over a compiled HLO module's text."""
+    out: Dict[str, Dict[str, int]] = {}
+    for line in hlo_text.splitlines():
+        m = _HLO_OP_RE.match(line)
+        if m is None:
+            continue
+        shape_str, op = m.groups()
+        if op.endswith("-done"):
+            continue  # counted at the matching -start
+        if op.endswith("-start"):
+            op = op[: -len("-start")]
+        if op not in COLLECTIVE_KINDS:
+            continue
+        rec = out.setdefault(op, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += _shape_bytes(shape_str)
+    return out
+
+
+def compile_case(case) -> Tuple[object, object]:
+    """(lowered, compiled) for a DryrunCase's train step — never executed.
+
+    Mirrors ``__graft_entry__.dryrun_multichip``'s init/step sequence
+    exactly (init on the first batch, step args from the second) so the
+    audited program IS the dryrun program, then stops at ``.compile()``.
+    """
+    with case.mesh:
+        case.trainer.init(next(iter(case.loader))["tokens"])
+        batch = next(iter(case.loader))
+        lowered = case.trainer.train_step.lower(case.trainer.state, batch)
+        compiled = lowered.compile()
+    return lowered, compiled
+
+
+def collective_record(case, compiled) -> Dict[str, object]:
+    """One budget-file entry for a compiled case."""
+    text = compiled.as_text()
+    return {
+        "mesh": {k: int(v) for k, v in dict(case.mesh.shape).items()},
+        "global_batch": int(case.global_batch),
+        "collectives": parse_collectives(text),
+    }
+
+
+def compare_budgets(
+    committed: Dict[str, Dict[str, int]],
+    measured: Dict[str, Dict[str, int]],
+    byte_tolerance: float = DEFAULT_BYTE_TOLERANCE,
+    config: Optional[str] = None,
+) -> Tuple[List[Finding], List[str]]:
+    """(violations, notes) of a measured collective set vs its budget.
+
+    Count increases and >tolerance byte increases are violations (a new
+    collective kind is both). Decreases are improvement notes — commit a
+    budget refresh (``scripts/graft_lint.py --write-budgets``) to ratchet
+    them in.
+    """
+    violations: List[Finding] = []
+    notes: List[str] = []
+    for kind in sorted(set(committed) | set(measured)):
+        c = committed.get(kind, {"count": 0, "bytes": 0})
+        m = measured.get(kind, {"count": 0, "bytes": 0})
+        if m["count"] > c["count"]:
+            violations.append(Finding(
+                rule="comm-budget-count",
+                where=kind,
+                message=(
+                    f"{kind} count {c['count']} -> {m['count']} "
+                    f"(+{m['count'] - c['count']})"
+                ),
+                config=config,
+            ))
+        elif m["count"] < c["count"]:
+            notes.append(
+                f"{config or ''} {kind}: count {c['count']} -> {m['count']} "
+                f"(improvement; refresh budgets to ratchet)"
+            )
+        budget = c["bytes"] * (1.0 + byte_tolerance)
+        if m["bytes"] > budget:
+            violations.append(Finding(
+                rule="comm-budget-bytes",
+                where=kind,
+                message=(
+                    f"{kind} bytes {c['bytes']} -> {m['bytes']} "
+                    f"(+{_pct(c['bytes'], m['bytes'])}, tolerance "
+                    f"{byte_tolerance:.0%})"
+                ),
+                config=config,
+            ))
+        elif m["bytes"] < c["bytes"] * (1.0 - byte_tolerance):
+            notes.append(
+                f"{config or ''} {kind}: bytes {c['bytes']} -> {m['bytes']} "
+                f"(improvement; refresh budgets to ratchet)"
+            )
+    return violations, notes
+
+
+def _pct(old: int, new: int) -> str:
+    if old == 0:
+        return "new"
+    return f"{(new - old) / old:+.1%}"
+
+
+def load_budgets(path: str = DEFAULT_BUDGETS_PATH) -> Dict[str, object]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_budgets(
+    path: str,
+    records: Dict[str, Dict[str, object]],
+    n_devices: int,
+    byte_tolerance: float = DEFAULT_BYTE_TOLERANCE,
+) -> None:
+    """Commit a fresh budget file (sorted keys: reviewable diffs)."""
+    import jax
+
+    payload = {
+        "_meta": {
+            "n_devices": n_devices,
+            "jax": jax.__version__,
+            "byte_tolerance": byte_tolerance,
+            "tool": "scripts/graft_lint.py --write-budgets",
+        },
+        "configs": {k: records[k] for k in sorted(records)},
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def jax_version_skew(budgets: Dict[str, object]) -> Optional[str]:
+    """The committed jax version when it differs from the runtime's.
+
+    Collective counts are only comparable against budgets generated by
+    the same jax/XLA — under skew the gate degrades to warnings (the
+    alternative is a hard failure on every toolchain bump).
+    """
+    import jax
+
+    committed = budgets.get("_meta", {}).get("jax")
+    if committed is not None and committed != jax.__version__:
+        return str(committed)
+    return None
+
+
+def budget_staleness(
+    budgets_path: str = DEFAULT_BUDGETS_PATH,
+    repo_root: Optional[str] = None,
+) -> Optional[str]:
+    """Human note when sources are newer than the committed budget file.
+
+    mtime-based — a hint for ``bench_gate``/CLI reports, not a gate: a
+    source edit that changes no collective legitimately leaves budgets
+    untouched.
+    """
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        )))
+    if not os.path.exists(budgets_path):
+        return f"no committed budgets at {budgets_path}"
+    budget_mtime = os.path.getmtime(budgets_path)
+    newest: Tuple[float, str] = (-math.inf, "")
+    pkg = os.path.join(repo_root, "distributed_pytorch_example_tpu")
+    candidates = [os.path.join(repo_root, "__graft_entry__.py")]
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        candidates.extend(
+            os.path.join(dirpath, f) for f in filenames if f.endswith(".py")
+        )
+    for path in candidates:
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue
+        if mtime > newest[0]:
+            newest = (mtime, path)
+    if newest[0] > budget_mtime:
+        rel = os.path.relpath(newest[1], repo_root)
+        return (
+            f"comm_budgets.json is older than {rel} — if the change "
+            f"touched sharding/collectives, refresh with "
+            f"`python scripts/graft_lint.py --write-budgets`"
+        )
+    return None

@@ -1,0 +1,25 @@
+"""The one record every graft-lint layer reports."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """A single lint/audit violation.
+
+    ``rule`` is a stable kebab-case id (tests key on it); ``where`` is a
+    human-locatable site — ``file:line`` for AST lints, a tree path for
+    sharding lints, ``config/op`` for budget violations.
+    """
+
+    rule: str
+    where: str
+    message: str
+    config: Optional[str] = None  # dryrun mesh config name, if per-config
+
+    def render(self) -> str:
+        prefix = f"[{self.config}] " if self.config else ""
+        return f"{prefix}{self.rule}: {self.where}: {self.message}"

@@ -1,0 +1,264 @@
+"""Repo-specific Python AST lints (no jax import, no backend).
+
+Three rules, each a distilled past-regression class:
+
+- ``host-sync``: ``.item()`` / ``np.asarray`` / ``jax.device_get`` inside
+  TRACED-SCOPE sources (``ops/``, ``models/``, ``parallel/``,
+  ``train/tasks.py``, ``train/step.py``) — the modules whose functions
+  are reachable from the jitted step. A host sync there either fails
+  tracing or, worse, silently forces a device round-trip per step (the
+  reference's per-batch ``loss.item()`` cost, reference train.py:144).
+- ``mesh-size-guess``: trace-time ``mesh.shape[...]`` reads or
+  ``data_parallel_size(...)`` calls inside ``ops/`` used to GUESS a
+  per-chip data size — the exact ADVICE r5 ``chunked_ce`` bug class: the
+  committed layout, not the mesh span, decides how much of an operand a
+  chip holds. Functions that inspect committed sharding (an
+  ``.sharding`` access / ``typeof`` call in the same function) pass,
+  because consulting the mesh as a FALLBACK after the layout is the
+  sanctioned pattern.
+- ``mutable-default``: ``[]``/``{}``/``set()`` defaults on public
+  functions anywhere in the package.
+
+Scope is static and name-based, not a whole-program call graph — the
+cheap 99% of the check. Deliberate exceptions carry a
+``# graft-lint: ok`` (all rules) or ``# graft-lint: <rule>`` comment on
+the offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set
+
+from distributed_pytorch_example_tpu.analysis.findings import Finding
+
+TRACED_SCOPE = (
+    "ops/", "models/", "parallel/", "train/tasks.py", "train/step.py",
+)
+MESH_GUESS_SCOPE = ("ops/",)
+
+_SUPPRESS_RE = re.compile(r"#\s*graft-lint:\s*([\w,-]+)")
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _suppressions(source: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[lineno] = {t.strip() for t in m.group(1).split(",")}
+    return out
+
+
+def _suppressed(supp: Dict[int, Set[str]], lineno: int, rule: str) -> bool:
+    tags = supp.get(lineno, set())
+    return "ok" in tags or rule in tags
+
+
+def _in_scope(relpath: str, scope: Sequence[str]) -> bool:
+    rel = relpath.replace(os.sep, "/")
+    return any(
+        rel.startswith(s) or rel == s.rstrip("/") for s in scope
+    )
+
+
+def _module_aliases(tree: ast.Module) -> Dict[str, Set[str]]:
+    """Local names bound to the numpy and jax modules."""
+    aliases = {"numpy": {"numpy"}, "jax": {"jax"}}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("numpy", "jax"):
+                    aliases[a.name].add(a.asname or a.name)
+    return aliases
+
+
+def _attr_root(node: ast.AST) -> Optional[str]:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _FuncStack(ast.NodeVisitor):
+    """Generic visitor that tracks the enclosing function def chain."""
+
+    def __init__(self):
+        self.stack: List[ast.AST] = []
+
+    def visit_FunctionDef(self, node):
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _inspects_committed_sharding(func: ast.AST) -> bool:
+    """Whether a function consults committed layout (``.sharding`` /
+    ``typeof``) — mesh-span reads are then the sanctioned fallback."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Attribute) and node.attr == "sharding":
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name in ("typeof", "get_aval"):
+                return True
+            if name == "getattr" and any(
+                isinstance(a, ast.Constant) and a.value == "sharding"
+                for a in node.args
+            ):
+                return True
+    return False
+
+
+def lint_source(relpath: str, source: str) -> List[Finding]:
+    """All AST findings for one package source file.
+
+    ``relpath`` is the path relative to the package root (forward or OS
+    separators), which selects the applicable rule scopes.
+    """
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(
+            rule="syntax-error", where=f"{relpath}:{e.lineno}",
+            message=str(e),
+        )]
+    supp = _suppressions(source)
+    aliases = _module_aliases(tree)
+    findings: List[Finding] = []
+    traced = _in_scope(relpath, TRACED_SCOPE)
+    mesh_scope = _in_scope(relpath, MESH_GUESS_SCOPE)
+
+    visitor = _FuncStack()
+    sharding_aware: Dict[ast.AST, bool] = {}
+
+    def enclosing_inspects() -> bool:
+        for func in reversed(visitor.stack):
+            if func not in sharding_aware:
+                sharding_aware[func] = _inspects_committed_sharding(func)
+            if sharding_aware[func]:
+                return True
+        return False
+
+    def visit_Call(node: ast.Call):
+        if traced:
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute) and fn.attr == "item"
+                and not node.args and not node.keywords
+                and not _suppressed(supp, node.lineno, "host-sync")
+            ):
+                findings.append(Finding(
+                    rule="host-sync",
+                    where=f"{relpath}:{node.lineno}",
+                    message=".item() forces a device->host sync per call "
+                            "inside traced scope",
+                ))
+            if isinstance(fn, ast.Attribute) and (
+                (fn.attr == "asarray"
+                 and _attr_root(fn) in aliases["numpy"])
+                or (fn.attr == "device_get"
+                    and _attr_root(fn) in aliases["jax"])
+            ) and not _suppressed(supp, node.lineno, "host-sync"):
+                findings.append(Finding(
+                    rule="host-sync",
+                    where=f"{relpath}:{node.lineno}",
+                    message=f"{ast.unparse(fn)}(...) materializes on host "
+                            "inside traced scope",
+                ))
+        if mesh_scope:
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if (
+                name == "data_parallel_size"
+                and not enclosing_inspects()
+                and not _suppressed(supp, node.lineno, "mesh-size-guess")
+            ):
+                findings.append(Finding(
+                    rule="mesh-size-guess",
+                    where=f"{relpath}:{node.lineno}",
+                    message="data_parallel_size(mesh) guesses a per-chip "
+                            "size from the mesh span; derive it from the "
+                            "operand's committed sharding (fall back to "
+                            "the conservative global size when unknown)",
+                ))
+        visitor.generic_visit(node)
+
+    def visit_Subscript(node: ast.Subscript):
+        if mesh_scope:
+            v = node.value
+            if (
+                isinstance(v, ast.Attribute) and v.attr == "shape"
+                and isinstance(v.value, ast.Name)
+                and "mesh" in v.value.id.lower()
+                and not enclosing_inspects()
+                and not _suppressed(supp, node.lineno, "mesh-size-guess")
+            ):
+                findings.append(Finding(
+                    rule="mesh-size-guess",
+                    where=f"{relpath}:{node.lineno}",
+                    message="mesh.shape[...] read at trace time to size "
+                            "data; use the committed sharding instead",
+                ))
+        visitor.generic_visit(node)
+
+    def visit_def(node):
+        if not node.name.startswith("_"):
+            mutable = (ast.List, ast.Dict, ast.Set)
+            for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                is_call_ctor = (
+                    isinstance(default, ast.Call)
+                    and isinstance(default.func, ast.Name)
+                    and default.func.id in ("list", "dict", "set")
+                )
+                if (
+                    (isinstance(default, mutable) or is_call_ctor)
+                    and not _suppressed(
+                        supp, default.lineno, "mutable-default"
+                    )
+                ):
+                    findings.append(Finding(
+                        rule="mutable-default",
+                        where=f"{relpath}:{default.lineno}",
+                        message=f"public API {node.name}() has a mutable "
+                                "default argument (shared across calls)",
+                    ))
+        _FuncStack.visit_FunctionDef(visitor, node)
+
+    visitor.visit_Call = visit_Call
+    visitor.visit_Subscript = visit_Subscript
+    visitor.visit_FunctionDef = visit_def
+    visitor.visit_AsyncFunctionDef = visit_def
+    visitor.visit(tree)
+    return findings
+
+
+def lint_package(root: Optional[str] = None) -> List[Finding]:
+    """AST findings over every ``.py`` source in the package tree."""
+    root = root or package_root()
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d != "__pycache__"
+        )
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            with open(path) as f:
+                findings.extend(lint_source(rel, f.read()))
+    return findings

@@ -1,0 +1,232 @@
+"""graft-lint orchestration: AST + jaxpr + collective audits in one pass.
+
+Glues the three analysis layers to the dryrun mesh-config table
+(``__graft_entry__.DRYRUN_CONFIGS``) and the committed budgets:
+
+- AST lints (``pylint_rules``) run first — no jax, milliseconds;
+- numerics lints (``shardlint.lint_dtype_promotions``) trace the bf16
+  flagship-shaped step once;
+- per-config audits lower+compile each requested mesh config on the fake
+  CPU mesh (never executing a step) and check collective budgets,
+  dropped donations, and large replicated params.
+
+Configs the toolchain cannot compile produce ``{"error": ...}`` records:
+the committed budget file documents the gap (e.g. jax 0.4.x cannot
+compile partial-auto ``shard_map`` pipelines — ``axis_index`` lowers to
+a PartitionId op its SPMD partitioner rejects), and an error matching the
+committed error is a note, not a violation. Budget comparisons degrade to
+warnings entirely when the runtime jax differs from the budget file's
+``_meta.jax`` (collective counts are only stable within one toolchain).
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from distributed_pytorch_example_tpu.analysis import collectives as coll
+from distributed_pytorch_example_tpu.analysis import pylint_rules
+from distributed_pytorch_example_tpu.analysis import shardlint
+from distributed_pytorch_example_tpu.analysis.findings import Finding
+
+
+@dataclass
+class AuditResult:
+    violations: List[Finding] = field(default_factory=list)
+    notes: List[str] = field(default_factory=list)
+    records: Dict[str, Dict[str, object]] = field(default_factory=dict)
+    configs_audited: int = 0
+    configs_errored: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def rule_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.violations:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def error_record(exc: BaseException) -> Dict[str, object]:
+    first = str(exc).splitlines()[0] if str(exc) else ""
+    return {"error": f"{type(exc).__name__}: {first[:200]}"}
+
+
+def _resolve_configs(names: Optional[Sequence[str]]):
+    import __graft_entry__ as entry
+
+    table = {
+        entry.dryrun_config_name(c): c for c in entry.DRYRUN_CONFIGS
+    }
+    if names is None:
+        return list(table.items())
+    missing = [n for n in names if n not in table]
+    if missing:
+        raise SystemExit(
+            f"unknown config(s) {missing}; known: {sorted(table)}"
+        )
+    return [(n, table[n]) for n in names]
+
+
+def audit_configs(
+    config_names: Optional[Sequence[str]] = None,
+    budgets: Optional[Dict[str, object]] = None,
+    n_devices: int = 8,
+    byte_tolerance: float = coll.DEFAULT_BYTE_TOLERANCE,
+    check_placement: bool = True,
+    log=lambda msg: print(msg, file=sys.stderr),
+) -> AuditResult:
+    """Compile each config and audit collectives / donation / placement.
+
+    With ``budgets=None`` no budget comparison happens (measure-only —
+    the ``--write-budgets`` path); otherwise each measured record is
+    gated against ``budgets["configs"][name]``.
+    """
+    import __graft_entry__ as entry
+
+    entry._ensure_cpu_devices(n_devices)
+    import jax
+
+    devices = jax.devices()[:n_devices]
+    result = AuditResult()
+    skew = coll.jax_version_skew(budgets) if budgets else None
+    if skew is not None:
+        result.notes.append(
+            f"budgets were generated under jax {skew}, runtime is "
+            f"{jax.__version__}: budget comparisons degraded to warnings"
+        )
+    committed_configs = (budgets or {}).get("configs", {})
+
+    for name, config in _resolve_configs(config_names):
+        case = entry.build_dryrun_case(config, devices)
+        if isinstance(case, str):
+            result.records[name] = {"skip": case}
+            result.notes.append(f"{name}: skipped ({case})")
+            continue
+        try:
+            lowered, compiled = coll.compile_case(case)
+            record = coll.collective_record(case, compiled)
+        except Exception as e:  # compile failures become budget records
+            record = error_record(e)
+            result.records[name] = record
+            result.configs_errored += 1
+            committed = committed_configs.get(name)
+            if budgets is None or (
+                committed is not None and "error" in committed
+            ):
+                result.notes.append(
+                    f"{name}: does not compile here ({record['error']})"
+                )
+            elif skew is not None:
+                result.notes.append(
+                    f"{name}: compile error under skewed jax "
+                    f"({record['error']})"
+                )
+            else:
+                result.violations.append(Finding(
+                    rule="comm-compile-error", where=name,
+                    message=record["error"], config=name,
+                ))
+            continue
+        result.records[name] = record
+        result.configs_audited += 1
+        log(f"graft_lint: {name} compiled; "
+            f"collectives={record['collectives']}")
+
+        if budgets is not None:
+            committed = committed_configs.get(name)
+            if committed is None:
+                result.violations.append(Finding(
+                    rule="comm-budget-missing", where=name,
+                    message="no committed budget for this config; run "
+                            "scripts/graft_lint.py --write-budgets",
+                    config=name,
+                ))
+            elif "error" in committed:
+                result.notes.append(
+                    f"{name}: compiles now but budget records an error — "
+                    f"refresh budgets to ratchet the gain in"
+                )
+            else:
+                v, n = coll.compare_budgets(
+                    committed["collectives"], record["collectives"],
+                    byte_tolerance=byte_tolerance, config=name,
+                )
+                if skew is not None:
+                    result.notes.extend(
+                        f"(skew-demoted) {f.render()}" for f in v
+                    )
+                else:
+                    result.violations.extend(v)
+                result.notes.extend(n)
+
+        if check_placement:
+            result.violations.extend(shardlint.lint_dropped_donation(
+                lowered, compiled, config=name
+            ))
+            result.violations.extend(shardlint.lint_replicated_params(
+                case.trainer.state.params, case.trainer.partitioner,
+                config=name,
+            ))
+    return result
+
+
+def audit_numerics() -> List[Finding]:
+    """bf16-upcast lint over the flagship-shaped bf16 train step."""
+    jaxpr = shardlint.flagship_numerics_jaxpr()
+    return shardlint.lint_dtype_promotions(jaxpr)
+
+
+def run_audit(
+    config_names: Optional[Sequence[str]] = None,
+    budgets_path: str = coll.DEFAULT_BUDGETS_PATH,
+    write_budgets: bool = False,
+    n_devices: int = 8,
+    with_collectives: bool = True,
+    with_numerics: bool = True,
+    with_ast: bool = True,
+    log=lambda msg: print(msg, file=sys.stderr),
+) -> AuditResult:
+    """The full graft-lint pass (the CLI and pytest wrapper entry point)."""
+    result = AuditResult()
+
+    if with_ast:
+        result.violations.extend(pylint_rules.lint_package())
+
+    if with_numerics or with_collectives:
+        import __graft_entry__ as entry
+
+        entry._ensure_cpu_devices(n_devices)
+
+    if with_numerics:
+        result.violations.extend(audit_numerics())
+
+    if with_collectives:
+        budgets = None
+        if not write_budgets:
+            try:
+                budgets = coll.load_budgets(budgets_path)
+            except FileNotFoundError:
+                result.notes.append(
+                    f"no committed budgets at {budgets_path}; "
+                    f"measuring without a gate (--write-budgets to commit)"
+                )
+        sub = audit_configs(
+            config_names, budgets=budgets, n_devices=n_devices, log=log,
+        )
+        result.violations.extend(sub.violations)
+        result.notes.extend(sub.notes)
+        result.records.update(sub.records)
+        result.configs_audited = sub.configs_audited
+        result.configs_errored = sub.configs_errored
+        if write_budgets:
+            coll.write_budgets(budgets_path, result.records, n_devices)
+            result.notes.append(f"wrote budgets to {budgets_path}")
+
+    stale = coll.budget_staleness(budgets_path)
+    if stale and not write_budgets:
+        result.notes.append(stale)
+    return result

@@ -446,6 +446,13 @@ def make_chunked_ce_last(prep, targets, sp):
     (:func:`shifted_ce_last_args`) normalized by the static global token
     count — summing the per-chunk partials over the seq axis (the
     schedule's psum) reproduces the non-SP per-microbatch mean exactly.
+
+    Deliberate overhead on the SP path: the CE evaluates EVERY position,
+    including the weight-zeroed padded last position that the non-SP path
+    slices away (``h[:, :-1]``) — one extra vocab-matmul row per sequence
+    per microbatch, exact but wasted FLOPs that grow with vocab size.
+    Masking (not slicing) is what keeps the chunk split exact under ANY
+    seq chunking, so this is a correctness trade, not a bug.
     """
     from distributed_pytorch_example_tpu.ops.chunked_ce import (
         chunked_softmax_xent,

@@ -187,6 +187,41 @@ def _bwd(block_size, dtype, serial, res, g):
 _chunked_xent.defvjp(_fwd, _bwd)
 
 
+def _local_token_count(hidden, n: int) -> int:
+    """Per-chip token count of ``hidden``'s leading dims for the HBM guard.
+
+    The operand's COMMITTED sharding is the truth when it is available (a
+    placed concrete array, or an aval carrying explicit sharding): count
+    the tokens of ONE shard. When the layout is unknown — the usual case
+    for an activation tracer inside jit — assume all ``n`` tokens are
+    chip-resident: over-serializing an actually-sharded operand costs
+    only perf, while sizing a replicated operand by the mesh span (the
+    old ``n // data_parallel_size(mesh)``) under-counts by the span and
+    disengages the guard in exactly the memory-bound regime it protects.
+    """
+    try:
+        sharding = getattr(hidden, "sharding", None)
+    except Exception:
+        sharding = None
+    if sharding is None:
+        from distributed_pytorch_example_tpu.runtime.jax_compat import typeof
+
+        try:
+            sharding = getattr(typeof(hidden), "sharding", None)
+        except Exception:
+            sharding = None
+    if sharding is not None and hasattr(sharding, "shard_shape"):
+        try:
+            local = sharding.shard_shape(tuple(hidden.shape))
+        except Exception:
+            return n
+        count = 1
+        for d in local[:-1]:
+            count *= int(d)
+        return count
+    return n
+
+
 def chunked_softmax_xent(
     hidden: jax.Array,
     embedding: jax.Array,
@@ -232,20 +267,13 @@ def chunked_softmax_xent(
     # long-context guard — see the constants' comment: serialize when the
     # all-blocks-concurrent f32 logits could threaten HBM, and shrink
     # oversized blocks (lane-aligned, equal FLOPs) so XLA's remat clones
-    # stay small too. ``n`` here is the TRACE-TIME (global) token count;
-    # under GSPMD data parallelism each chip holds only n / dp_size of
-    # it, so the decision uses the per-shard count — otherwise an 8-way
-    # DP run at bench-scale per-chip memory would trip the guard the
-    # budget deliberately keeps off. (The SP x PP chunk-local path calls
-    # this INSIDE shard_map where n is already local and tiny; dividing
-    # again only makes serialization rarer there, which is safe.)
-    from distributed_pytorch_example_tpu.runtime.mesh import (
-        current_mesh,
-        data_parallel_size,
-    )
-
-    mesh = current_mesh()
-    n_shard = n // (data_parallel_size(mesh) if mesh is not None else 1)
+    # stay small too. The decision keys on the PER-CHIP token count,
+    # derived from ``hidden``'s committed sharding when the layout is
+    # known; with an unknown layout the guard assumes the full ``n`` is
+    # resident. (The SP x PP chunk-local path calls this INSIDE shard_map
+    # where n is already local and tiny, so the conservative fallback
+    # stays off there.)
+    n_shard = _local_token_count(hidden, n)
     block = int(block_size)
     serial = n_shard * embedding.shape[0] * 4 > _SERIALIZE_TOTAL_BYTES
     if serial and n_shard * block * 4 > _SERIALIZE_BLOCK_BYTES:

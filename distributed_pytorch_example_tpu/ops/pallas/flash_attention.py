@@ -278,7 +278,9 @@ def _fwd_single(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret):
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying ``like``'s varying-manual-axes set, so the
     kernels compose with shard_map manual axes (ring attention's folds)."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    from distributed_pytorch_example_tpu.runtime.jax_compat import typeof
+
+    vma = getattr(typeof(like), "vma", None)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

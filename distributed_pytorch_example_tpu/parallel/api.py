@@ -49,11 +49,18 @@ def pvary_like(tree: Any, like: jax.Array, extra_axes: Sequence[str] = ()) -> An
     """
     from jax import lax
 
-    target = set(jax.typeof(like).vma) | set(extra_axes)
+    from distributed_pytorch_example_tpu.runtime.jax_compat import (
+        has_vma_types, typeof,
+    )
+
+    if not has_vma_types():
+        return tree  # pre-vma jax: nothing to stamp
+
+    target = set(typeof(like).vma) | set(extra_axes)
     pcast = getattr(lax, "pcast", None)
 
     def mark(x):
-        missing = tuple(target - set(jax.typeof(x).vma))
+        missing = tuple(target - set(typeof(x).vma))
         if not missing:
             return x
         if pcast is not None:

@@ -47,6 +47,10 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_pytorch_example_tpu.parallel.api import pvary_like
+from distributed_pytorch_example_tpu.runtime.jax_compat import (
+    axis_size as _axis_size,
+    shard_map,
+)
 
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
@@ -99,7 +103,7 @@ def _gpipe_local(stage_params, in_buf, *, stage_fn: StageFn, axis_name: str,
     axis, so the returned aux is the total over all (layer, microbatch)
     contributions.
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     m = in_buf.shape[0]
     params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
@@ -266,7 +270,7 @@ def gpipe(
         x_stack, NamedSharding(mesh, queue_spec)
     )
 
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _gpipe_local, stage_fn=stage_fn, axis_name=pipe_axis,
             n_micro=n_micro, aux_init=aux_init,
@@ -377,12 +381,19 @@ def one_f_one_b_cycles(n_micro: int, n_stages: int,
     are conflict-free per device). The last backward (wave W-1, slot S-1,
     chunk 0) lands at ``(W-1)V + S-1 + 2(V-1)``; the dx delivery ring adds
     ``S-1`` more. At ``n_virtual=1`` this reduces exactly to the classic
-    ``n_micro + 3(n_stages-1)``.
+    ``n_micro + 3(n_stages-1)``, which is returned for ANY ``n_micro``
+    (the non-interleaved 1F1B count needs no whole waves; keeping the
+    formula total preserves its long-standing public behavior) — only the
+    interleaved schedule (``n_virtual > 1``) structurally requires
+    ``n_micro % n_stages == 0`` and raises otherwise.
     """
+    if n_virtual == 1:
+        return n_micro + 3 * (n_stages - 1)
     if n_micro % n_stages:
         raise ValueError(
             f"n_micro {n_micro} not divisible by n_stages {n_stages} — the "
-            "wave schedule (and one_f_one_b itself) requires whole waves"
+            f"interleaved (n_virtual={n_virtual}) wave schedule requires "
+            "whole waves"
         )
     V = n_stages * n_virtual
     waves = n_micro // n_stages
@@ -477,7 +488,7 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
     dx_buf) — loss/metrics/aux psum'd over pipe (and seq); d_stage/dx stay
     sharded over pipe (d_stage seq-reduced, dx seq-chunked).
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     is_last = stage == n_stages - 1
     is_first = stage == 0
@@ -750,7 +761,7 @@ def _1f1b_run(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
         if seq is None
         else (lambda a: P(None, None, seq) if a.ndim >= 3 else P())
     )
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _1f1b_local, stage_fn=stage_fn, last_fn=last_fn,
             axis_name=pipe_axis, n_micro=n_micro, aux_desc=aux_desc,

@@ -1,0 +1,77 @@
+"""Version-compat shims for the narrow jax-0.9 API surface this repo uses.
+
+The framework targets the pinned ``jax==0.9.0`` (requirements.txt), but
+CI/audit containers may carry an older jax (0.4.x), where the same
+functionality lives under different names:
+
+- ``jax.shard_map``            -> ``jax.experimental.shard_map.shard_map``
+  (``axis_names={...}`` partial-manual selection -> the complementary
+  ``auto=frozenset(...)``; the 0.4.x replication checker predates the
+  custom-VJP-under-shard_map patterns used here, so it is disabled)
+- ``jax.typeof``               -> ``jax.core.get_aval`` (no ``vma`` set:
+  the varying-manual-axes type system does not exist in 0.4.x, so
+  vma-stamping helpers degrade to no-ops, which is exactly right — there
+  is nothing to stamp)
+
+Keep this module tiny and one-directional: new code writes against the
+0.9 API via these wrappers; nothing here emulates 0.4.x on 0.9.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs,
+              axis_names: Optional[Set[str]] = None, **kwargs):
+    """``jax.shard_map`` with 0.4.x fallback (same call shape).
+
+    ``axis_names`` selects the manual axes (0.9 semantics); on 0.4.x the
+    complement of the mesh's axis names is passed as ``auto``.
+    """
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return native(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    mapped = _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False, auto=auto,
+    )
+    if auto:
+        # 0.4.x partial-auto shard_map only lowers under jit (eager raises
+        # a bare NotImplementedError); 0.9 supports eager, so match it
+        mapped = jax.jit(mapped)
+    return mapped
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` with 0.4.x fallback to the axis-env lookup."""
+    native = getattr(jax.lax, "axis_size", None)
+    if native is not None:
+        return native(axis_name)
+    from jax._src import core as jcore
+
+    return int(jcore.axis_frame(axis_name))
+
+
+def typeof(x):
+    """``jax.typeof`` with 0.4.x fallback to the aval (no ``vma`` attr)."""
+    native = getattr(jax, "typeof", None)
+    if native is not None:
+        return native(x)
+    return jax.core.get_aval(x)
+
+
+def has_vma_types() -> bool:
+    """Whether this jax has the varying-manual-axes type system."""
+    return hasattr(jax, "typeof")

@@ -17,6 +17,15 @@ root. Both the driver's wrapped format ({"n":…,"tail":"…"} with the bench
 line embedded in the tail) and a raw bench.py stdout line are accepted on
 either side. Models present on only one side are reported but do not fail
 the gate (new models have no baseline; removed models are a visible note).
+
+This gate covers RUNTIME throughput only; its static sibling is
+``scripts/graft_lint.py``, which gates compiled-HLO collective
+counts/bytes against the committed ``analysis/comm_budgets.json``. The
+budget file is a committed artifact like ``BENCH_r*.json`` and goes stale
+the same way: after a deliberate sharding/schedule change, refresh it
+with ``graft_lint.py --write-budgets`` in the same commit — a stale
+budget file turns every later sweep into noise (spurious improvements or
+violations that belong to the earlier change).
 """
 
 from __future__ import annotations

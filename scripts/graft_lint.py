@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""graft-lint CLI: static sharding/collective/numerics auditor.
+
+Runs the three analysis layers (AST lints, jaxpr numerics lints,
+per-mesh-config collective/donation/placement audits) without executing a
+single train step, and gates collective counts/bytes against the
+committed ``analysis/comm_budgets.json``.
+
+Driver contract (same as bench.py): stdout carries exactly ONE JSON line;
+every detail — per-config collective tables, violation renderings,
+notes — goes to stderr. Exit status is non-zero iff there are violations.
+
+Usage:
+    python scripts/graft_lint.py                  # full audit, all configs
+    python scripts/graft_lint.py --configs data+fsdp+expert
+    python scripts/graft_lint.py --no-collectives # AST + numerics only
+    python scripts/graft_lint.py --write-budgets  # refresh the budget file
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    ap.add_argument(
+        "--configs", default=None,
+        help="comma-separated dryrun config names (default: all)",
+    )
+    ap.add_argument(
+        "--budgets", default=None,
+        help="budget file path (default: analysis/comm_budgets.json)",
+    )
+    ap.add_argument(
+        "--write-budgets", action="store_true",
+        help="measure and overwrite the budget file instead of gating",
+    )
+    ap.add_argument("--devices", type=int, default=8,
+                    help="fake CPU mesh size (default 8)")
+    ap.add_argument("--no-collectives", action="store_true",
+                    help="skip the per-config compile audits")
+    ap.add_argument("--no-numerics", action="store_true",
+                    help="skip the bf16-upcast jaxpr lint")
+    ap.add_argument("--no-ast", action="store_true",
+                    help="skip the AST lints")
+    args = ap.parse_args()
+
+    from distributed_pytorch_example_tpu.analysis import collectives as coll
+    from distributed_pytorch_example_tpu.analysis import runner
+
+    result = runner.run_audit(
+        config_names=args.configs.split(",") if args.configs else None,
+        budgets_path=args.budgets or coll.DEFAULT_BUDGETS_PATH,
+        write_budgets=args.write_budgets,
+        n_devices=args.devices,
+        with_collectives=not args.no_collectives,
+        with_numerics=not args.no_numerics,
+        with_ast=not args.no_ast,
+    )
+
+    for f in result.violations:
+        print(f"VIOLATION {f.render()}", file=sys.stderr)
+    for n in result.notes:
+        print(f"note: {n}", file=sys.stderr)
+
+    jax_version = None
+    if not (args.no_collectives and args.no_numerics):
+        import jax
+
+        jax_version = jax.__version__
+    print(json.dumps({
+        "tool": "graft_lint",
+        "ok": result.ok,
+        "violations": len(result.violations),
+        "rules": result.rule_counts(),
+        "notes": len(result.notes),
+        "configs_audited": result.configs_audited,
+        "configs_errored": result.configs_errored,
+        "wrote_budgets": bool(args.write_budgets),
+        "jax": jax_version,
+    }))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -156,3 +156,54 @@ def test_serialized_long_context_path_matches(monkeypatch):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6
         )
+
+
+def test_local_token_count_committed_sharding(mesh_2x2x2):
+    """The HBM guard sizes tokens from the operand's COMMITTED sharding
+    when one is available (ADVICE r5): a batch-sharded placement counts
+    one shard, a replicated placement counts every token."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_pytorch_example_tpu.ops import chunked_ce as cc
+
+    sharded = jax.device_put(
+        jnp.zeros((8, 16, 8), jnp.float32),
+        NamedSharding(mesh_2x2x2, P(("data", "fsdp"))),
+    )
+    assert cc._local_token_count(sharded, 128) == 32  # 4-way batch shard
+    replicated = jax.device_put(
+        jnp.zeros((8, 16, 8), jnp.float32),
+        NamedSharding(mesh_2x2x2, P()),
+    )
+    assert cc._local_token_count(replicated, 128) == 128
+
+
+def test_serialize_guard_engages_for_replicated_batch(monkeypatch, mesh_2x2x2):
+    """ADVICE r5 regression: a replicated-layout trace under an ACTIVE
+    multi-chip mesh must not divide the token count by the mesh span —
+    the old ``n // data_parallel_size(mesh)`` guess disengaged the HBM
+    guard exactly where all ``n`` tokens are chip-resident. With the
+    layout unknown at trace time the guard now assumes the full ``n``
+    and threads its optimization barriers."""
+    from distributed_pytorch_example_tpu.analysis.shardlint import iter_eqns
+    from distributed_pytorch_example_tpu.ops import chunked_ce as cc
+
+    n, d, v = 64, 8, 64
+    # global all-blocks f32 logits: 64 * 64 * 4 = 16384 bytes. Threshold
+    # between that and the old mesh-span estimate (16384 / dp4 = 4096):
+    # the fixed guard serializes, the old guess would not.
+    monkeypatch.setattr(cc, "_SERIALIZE_TOTAL_BYTES", 8192)
+    hidden = jnp.zeros((4, 16, d), jnp.float32)
+    emb = jnp.zeros((v, d), jnp.float32)
+    tg = jnp.zeros((4, 16), jnp.int32)
+    with mesh_2x2x2:
+        jaxpr = jax.make_jaxpr(
+            lambda h, e, t: cc.chunked_softmax_xent(
+                h, e, t, block_size=32, dtype=jnp.float32
+            )
+        )(hidden, emb, tg)
+    barriers = [
+        e for e in iter_eqns(jaxpr)
+        if e.primitive.name == "optimization_barrier"
+    ]
+    assert barriers, "guard must engage when the layout is unknown"

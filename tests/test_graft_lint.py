@@ -1,0 +1,392 @@
+"""graft-lint: every rule fires exactly once on a seeded violation, a
+clean tree produces zero findings, and the collective budget gate catches
+a deliberately widened sharding end-to-end.
+
+Tier-1 scope: AST/parser/jaxpr unit tests plus ONE cheap mesh-config
+budget gate (data+fsdp+expert, ~7 s compile on the fake CPU mesh). The
+full 14-config sweep runs under ``-m slow``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_pytorch_example_tpu.analysis import collectives as coll
+from distributed_pytorch_example_tpu.analysis import pylint_rules
+from distributed_pytorch_example_tpu.analysis import shardlint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHEAP_CONFIG = "data+fsdp+expert"
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# AST lints: seeded violations fire exactly once; escapes work
+# ---------------------------------------------------------------------------
+
+
+def test_host_sync_item_fires_once():
+    src = (
+        "def step(loss):\n"
+        "    history = []\n"
+        "    history.append(loss.item())\n"
+        "    return history\n"
+    )
+    findings = pylint_rules.lint_source("train/tasks.py", src)
+    assert _rules(findings) == ["host-sync"]
+    assert "tasks.py:3" in findings[0].where
+
+
+def test_host_sync_numpy_alias_and_device_get():
+    src = (
+        "import numpy as xp\n"
+        "import jax as j\n"
+        "def f(x):\n"
+        "    a = xp.asarray(x)\n"
+        "    b = j.device_get(x)\n"
+        "    return a, b\n"
+    )
+    findings = pylint_rules.lint_source("ops/fused.py", src)
+    assert _rules(findings) == ["host-sync", "host-sync"]
+
+
+def test_host_sync_outside_traced_scope_ignored():
+    src = "def f(x):\n    return x.item()\n"
+    assert pylint_rules.lint_source("runtime/logging.py", src) == []
+
+
+def test_host_sync_suppression_comment():
+    src = (
+        "def f(x):\n"
+        "    return x.item()  # graft-lint: host-sync\n"
+    )
+    assert pylint_rules.lint_source("ops/fused.py", src) == []
+
+
+def test_mesh_size_guess_fires_once():
+    src = (
+        "def guard(n, mesh):\n"
+        "    n_shard = n // data_parallel_size(mesh)\n"
+        "    return n_shard * 4\n"
+    )
+    findings = pylint_rules.lint_source("ops/fused.py", src)
+    assert _rules(findings) == ["mesh-size-guess"]
+
+
+def test_mesh_size_guess_mesh_shape_subscript():
+    src = (
+        "def guard(n, mesh):\n"
+        "    return n // mesh.shape['data']\n"
+    )
+    findings = pylint_rules.lint_source("ops/fused.py", src)
+    assert _rules(findings) == ["mesh-size-guess"]
+
+
+def test_mesh_size_guess_excused_by_sharding_inspection():
+    # consulting the committed layout first makes the mesh span a
+    # sanctioned fallback (the fixed chunked_ce pattern)
+    src = (
+        "def guard(x, n, mesh):\n"
+        "    s = getattr(x, 'sharding', None)\n"
+        "    if s is not None:\n"
+        "        return shard_tokens(s)\n"
+        "    return n // data_parallel_size(mesh)\n"
+    )
+    assert pylint_rules.lint_source("ops/fused.py", src) == []
+
+
+def test_mutable_default_fires_once_public_only():
+    src = (
+        "def public_api(x, cache={}):\n"
+        "    return cache\n"
+        "def _private(x, cache={}):\n"
+        "    return cache\n"
+    )
+    findings = pylint_rules.lint_source("runtime/util.py", src)
+    assert _rules(findings) == ["mutable-default"]
+    assert "public_api" in findings[0].message
+
+
+def test_clean_package_zero_ast_findings():
+    assert pylint_rules.lint_package() == []
+
+
+# ---------------------------------------------------------------------------
+# HLO collective parser + budget comparator (pure string/dict logic)
+# ---------------------------------------------------------------------------
+
+_HLO_FIXTURE = """\
+HloModule jit_step, input_output_alias={ {0}: (0, {}, may-alias), {3}: (2, {}, may-alias) }
+
+ENTRY main {
+  %p0 = f32[4,16]{1,0} parameter(0)
+  %all-reduce = f32[4,16]{1,0} all-reduce(f32[4,16]{1,0} %p0)
+  %reduce = f32[] reduce(f32[4,16]{1,0} %all-reduce, f32[] %c)
+  %ag-start = (f32[4,16]{1,0}, f32[8,16]{1,0}) all-gather-start(f32[4,16]{1,0} %p0)
+  %ag-done = f32[8,16]{1,0} all-gather-done((f32[4,16]{1,0}, f32[8,16]{1,0}) %ag-start)
+  %rs = bf16[2,16]{1,0} reduce-scatter(bf16[4,16]{1,0} %x)
+  ROOT %cp = f32[4,16]{1,0} collective-permute(f32[4,16]{1,0} %all-reduce)
+}
+"""
+
+
+def test_parse_collectives_counts_and_bytes():
+    got = coll.parse_collectives(_HLO_FIXTURE)
+    # the `reduce(... %all-reduce ...)` operand must NOT count as a second
+    # all-reduce (ops are matched in the `= <shape> <op>(` position)
+    assert got["all-reduce"] == {"count": 1, "bytes": 4 * 16 * 4}
+    # -start/-done async pair counts once, bytes from the full start tuple
+    assert got["all-gather"]["count"] == 1
+    assert got["all-gather"]["bytes"] == (4 * 16 + 8 * 16) * 4
+    assert got["reduce-scatter"] == {"count": 1, "bytes": 2 * 16 * 2}
+    assert got["collective-permute"]["count"] == 1
+    assert "reduce" not in got  # plain reduce is not a collective
+
+
+def test_alias_parse():
+    assert shardlint.aliased_parameter_numbers(_HLO_FIXTURE) == {0, 2}
+    assert shardlint.aliased_parameter_numbers(
+        "HloModule bare\nENTRY e {}\n"
+    ) is None
+
+
+def test_compare_budgets_count_increase_is_violation():
+    committed = {"all-reduce": {"count": 2, "bytes": 100}}
+    measured = {"all-reduce": {"count": 3, "bytes": 100}}
+    v, notes = coll.compare_budgets(committed, measured, config="cfg")
+    assert _rules(v) == ["comm-budget-count"]
+    assert v[0].config == "cfg" and v[0].where == "all-reduce"
+
+
+def test_compare_budgets_byte_tolerance():
+    committed = {"all-gather": {"count": 1, "bytes": 1000}}
+    within = {"all-gather": {"count": 1, "bytes": 1040}}
+    beyond = {"all-gather": {"count": 1, "bytes": 1100}}
+    assert coll.compare_budgets(committed, within)[0] == []
+    v, _ = coll.compare_budgets(committed, beyond)
+    assert _rules(v) == ["comm-budget-bytes"]
+
+
+def test_compare_budgets_new_kind_and_improvement():
+    committed = {"all-reduce": {"count": 2, "bytes": 100}}
+    measured = {
+        "all-reduce": {"count": 1, "bytes": 50},
+        "all-to-all": {"count": 1, "bytes": 10},
+    }
+    v, notes = coll.compare_budgets(committed, measured)
+    assert _rules(v) == ["comm-budget-count", "comm-budget-bytes"]
+    assert all(f.where == "all-to-all" for f in v)  # the NEW kind fails
+    assert any("improvement" in n for n in notes)  # the decrease is a note
+
+
+# ---------------------------------------------------------------------------
+# jaxpr numerics lint
+# ---------------------------------------------------------------------------
+
+
+def test_bf16_upcast_seeded_fires():
+    def f(x):
+        big = x.astype(jnp.float32)  # (512, 256) = 128k elements
+        return big.sum()
+
+    jaxpr = jax.make_jaxpr(f)(
+        jax.ShapeDtypeStruct((512, 256), jnp.bfloat16)
+    )
+    findings = shardlint.lint_dtype_promotions(jaxpr)
+    assert _rules(findings) == ["bf16-upcast"]
+    assert "(512, 256)" in findings[0].message
+
+
+def test_bf16_upcast_small_and_allowlisted_pass():
+    def f(x):
+        return x.astype(jnp.float32).sum()
+
+    small = jax.make_jaxpr(f)(
+        jax.ShapeDtypeStruct((8, 8), jnp.bfloat16)
+    )
+    assert shardlint.lint_dtype_promotions(small) == []
+    big = jax.make_jaxpr(f)(
+        jax.ShapeDtypeStruct((512, 256), jnp.bfloat16)
+    )
+    assert shardlint.lint_dtype_promotions(
+        big, allowlist=(r"test_graft_lint\.py",)
+    ) == []
+
+
+def test_flagship_numerics_clean():
+    # the bf16 flagship-shaped step carries only allowlisted f32 islands
+    jaxpr = shardlint.flagship_numerics_jaxpr()
+    findings = shardlint.lint_dtype_promotions(jaxpr)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# donation + replication lints (compiled on the fake CPU backend)
+# ---------------------------------------------------------------------------
+
+
+def test_dropped_donation_seeded(devices):
+    def f(x):
+        return x[::2] * 2.0  # output shape != input: donation must drop
+
+    x = jnp.zeros((128, 256), jnp.float32)  # 128 KB, above the floor
+    lowered = jax.jit(f, donate_argnums=0).lower(x)
+    findings = shardlint.lint_dropped_donation(lowered, lowered.compile())
+    assert _rules(findings) == ["dropped-donation"]
+
+
+def test_dropped_donation_clean(devices):
+    def f(x):
+        return x + 1.0
+
+    x = jnp.zeros((128, 256), jnp.float32)
+    lowered = jax.jit(f, donate_argnums=0).lower(x)
+    assert shardlint.lint_dropped_donation(lowered, lowered.compile()) == []
+
+
+def test_replicated_large_param_seeded(mesh_2x2x2):
+    from distributed_pytorch_example_tpu.parallel.partition import (
+        transformer_partitioner,
+    )
+
+    partitioner = transformer_partitioner(mesh_2x2x2)
+    big = jax.device_put(
+        jnp.zeros((512, 512), jnp.float32),  # 1 MB, rule spans tensor=2
+        NamedSharding(mesh_2x2x2, P()),
+    )
+    params = {"decoder": {"attn": {"q": {"kernel": big}}}}
+    findings = shardlint.lint_replicated_params(params, partitioner)
+    assert _rules(findings) == ["replicated-large-param"]
+    assert "attn/q/kernel" in findings[0].where
+
+    placed = jax.device_put(
+        jnp.zeros((512, 512), jnp.float32),
+        NamedSharding(mesh_2x2x2, P(None, "tensor")),
+    )
+    assert shardlint.lint_replicated_params(
+        {"decoder": {"attn": {"q": {"kernel": placed}}}}, partitioner
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# collective budget gate: one cheap config in tier-1, perturbation check
+# ---------------------------------------------------------------------------
+
+
+def _build_case(name, devices):
+    sys.path.insert(0, REPO_ROOT)
+    import __graft_entry__ as entry
+
+    config = next(
+        c for c in entry.DRYRUN_CONFIGS
+        if entry.dryrun_config_name(c) == name
+    )
+    case = entry.build_dryrun_case(config, devices)
+    assert not isinstance(case, str), case
+    return case
+
+
+def test_budget_gate_cheap_config_green(devices):
+    budgets = coll.load_budgets()
+    committed = budgets["configs"][CHEAP_CONFIG]
+    assert "collectives" in committed, committed
+    case = _build_case(CHEAP_CONFIG, devices)
+    lowered, compiled = coll.compile_case(case)
+    record = coll.collective_record(case, compiled)
+    if coll.jax_version_skew(budgets) is not None:
+        pytest.skip("budget file from a different jax; gate degrades to "
+                    "warnings (refresh with --write-budgets)")
+    violations, _ = coll.compare_budgets(
+        committed["collectives"], record["collectives"], config=CHEAP_CONFIG
+    )
+    assert violations == [], [f.render() for f in violations]
+    # the same compile also passes the placement lints
+    assert shardlint.lint_dropped_donation(lowered, compiled) == []
+    assert shardlint.lint_replicated_params(
+        case.trainer.state.params, case.trainer.partitioner
+    ) == []
+
+
+def test_budget_gate_catches_widened_sharding(devices):
+    """Deliberately widening the sharding (dropping every partition rule
+    so params replicate) must fail the committed budget, naming the
+    config and the collective op kind."""
+    from distributed_pytorch_example_tpu.parallel.api import Partitioner
+
+    budgets = coll.load_budgets()
+    if coll.jax_version_skew(budgets) is not None:
+        pytest.skip("budget file from a different jax; gate degrades to "
+                    "warnings (refresh with --write-budgets)")
+    case = _build_case(CHEAP_CONFIG, devices)
+    # widen: no rules, replicate everything the partitioner used to shard
+    case.trainer.partitioner = Partitioner(case.mesh)
+    _, compiled = coll.compile_case(case)
+    record = coll.collective_record(case, compiled)
+    violations, _ = coll.compare_budgets(
+        budgets["configs"][CHEAP_CONFIG]["collectives"],
+        record["collectives"],
+        config=CHEAP_CONFIG,
+    )
+    assert violations, "replicating all params must change the collectives"
+    assert all(f.config == CHEAP_CONFIG for f in violations)
+    assert all(f.where in coll.COLLECTIVE_KINDS for f in violations)
+
+
+def test_budget_file_covers_all_configs():
+    sys.path.insert(0, REPO_ROOT)
+    import __graft_entry__ as entry
+
+    budgets = coll.load_budgets()
+    names = {entry.dryrun_config_name(c) for c in entry.DRYRUN_CONFIGS}
+    assert set(budgets["configs"]) == names
+    meta = budgets["_meta"]
+    assert meta["n_devices"] == 8 and "jax" in meta
+
+
+# ---------------------------------------------------------------------------
+# CLI driver contract
+# ---------------------------------------------------------------------------
+
+
+def test_cli_one_json_line_contract():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "graft_lint.py"),
+         "--no-collectives", "--no-numerics"],
+        capture_output=True, text=True, timeout=300, cwd=REPO_ROOT,
+    )
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    payload = json.loads(lines[0])
+    assert payload["tool"] == "graft_lint"
+    assert payload["ok"] is True and proc.returncode == 0
+    assert payload["violations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# full sweep (slow): every config either audits green or reproduces its
+# committed error record
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_full_budget_sweep(devices):
+    from distributed_pytorch_example_tpu.analysis import runner
+
+    budgets = coll.load_budgets()
+    result = runner.audit_configs(None, budgets=budgets)
+    assert result.violations == [], [f.render() for f in result.violations]
+    covered = result.configs_audited + result.configs_errored
+    assert covered + sum(
+        1 for r in result.records.values() if "skip" in r
+    ) == len(budgets["configs"])

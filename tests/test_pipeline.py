@@ -501,3 +501,17 @@ def test_aux_accumulation_excludes_bubble_ticks(devices):
     np.testing.assert_allclose(
         float(aux["mean_in"]), n_micro * (1 + 2 + 3 + 4 - 0), rtol=1e-6
     )
+
+
+def test_cycles_nondivisible_classic_form():
+    """ADVICE r5 back-compat pin: at n_virtual=1 the cycle count is the
+    classic closed form for ANY n_micro (no whole-wave precondition);
+    only the interleaved schedule raises on ragged waves."""
+    from distributed_pytorch_example_tpu.parallel.pipeline import (
+        one_f_one_b_cycles,
+    )
+
+    assert one_f_one_b_cycles(7, 4) == 7 + 3 * 3  # non-divisible, v=1
+    assert one_f_one_b_cycles(1, 4) == 1 + 3 * 3
+    with pytest.raises(ValueError, match="interleaved"):
+        one_f_one_b_cycles(7, 4, 2)

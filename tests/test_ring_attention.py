@@ -13,6 +13,7 @@ import pytest
 from distributed_pytorch_example_tpu.ops.attention import _xla_attention
 from distributed_pytorch_example_tpu.ops.ring_attention import ring_attention_sharded
 from distributed_pytorch_example_tpu.runtime import MeshSpec, make_mesh
+from distributed_pytorch_example_tpu.runtime.jax_compat import shard_map as _shard_map
 
 
 def make_qkv(batch=2, seq=256, heads=2, head_dim=32, seed=0):
@@ -165,7 +166,7 @@ def test_flash_folds_match_full_attention(devices, causal):
     # check_vma=False: the pallas HLO *interpreter* (CPU stand-in for the
     # TPU kernels) does not propagate varying-manual-axes through its
     # internal slicing; the compiled TPU path runs under full vma checking
-    ring = jax.shard_map(
+    ring = _shard_map(
         functools.partial(
             ring_attention, axis_name="sequence", causal=causal,
             use_flash=True, flash_interpret=True,
@@ -203,7 +204,7 @@ def test_backward_residuals_are_o_of_local_seq(devices):
     mesh = make_mesh(MeshSpec(data=2, sequence=4))
     q, k, v = make_qkv(batch=2, seq=256, head_dim=32)
     spec = P(None, "sequence", None, None)
-    ring = jax.shard_map(
+    ring = _shard_map(
         functools.partial(ring_attention, axis_name="sequence", causal=True),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )
@@ -234,7 +235,7 @@ def test_flash_folds_non_512_divisible_shard(devices):
     q, k, v = make_qkv(batch=1, seq=1280, heads=1, head_dim=64)
     scale = q.shape[-1] ** -0.5
     spec = P(None, "sequence", None, None)
-    ring = jax.shard_map(
+    ring = _shard_map(
         functools.partial(
             ring_attention, axis_name="sequence", causal=True,
             use_flash=True, flash_interpret=True,
@@ -349,7 +350,7 @@ def test_gqa_flash_folds_match_full_attention(devices, causal):
     scale = q.shape[-1] ** -0.5
     spec = P("data", "sequence", None, None)
     with mesh:
-        ring = jax.shard_map(
+        ring = _shard_map(
             functools.partial(
                 ring_attention, axis_name="sequence", causal=causal,
                 use_flash=True, flash_interpret=True,
