@@ -104,8 +104,15 @@ def run_model(name: str, args) -> dict:
     )
 
     mesh = dpx.runtime.make_mesh()
-    partitioner = dpx.parallel.data_parallel(mesh)
+    partitioner = dpx.parallel.data_parallel(
+        mesh, dp_shard_opt_state=args.zero1
+    )
     global_batch = batch_per_chip * n_chips
+    if batch_per_chip % args.grad_accum:
+        raise ValueError(
+            f"--grad-accum {args.grad_accum} must divide the per-chip "
+            f"batch ({batch_per_chip} for {name}; set --batch-per-chip)"
+        )
     rng = np.random.default_rng(0)
     if lm:
         flags_apply = True
@@ -165,7 +172,8 @@ def run_model(name: str, args) -> dict:
             "y": rng.integers(0, num_classes, (global_batch,)).astype(np.int32),
         }
     trainer = dpx.train.Trainer(
-        model, task, optax.adam(1e-3), partitioner=partitioner
+        model, task, optax.adam(1e-3), partitioner=partitioner,
+        grad_accum_steps=args.grad_accum,
     )
     sharding = partitioner.batch_sharding()
     batch = {
@@ -175,6 +183,11 @@ def run_model(name: str, args) -> dict:
 
     with mesh:
         trainer.init(batch["tokens" if lm else "x"])
+        # the ZeRO-1 observable: per-chip optimizer-state residency
+        # (shrinks ~1/n_chips under --zero1 vs the replicated update)
+        opt_bytes = dpx.train.opt_state_bytes_per_chip(
+            trainer.state.opt_state
+        )
         # AOT-compile once and drive the SAME executable for warmup and the
         # timed loop (a separate jit call would compile a second copy)
         step = trainer.train_step.lower(trainer.state, batch).compile()
@@ -206,6 +219,7 @@ def run_model(name: str, args) -> dict:
         "value": round(rate, 2),
         "unit": unit,
         "vs_baseline": round(rate / baseline, 3),
+        "opt_state_bytes_per_chip": opt_bytes,
         # self-describing config: round-over-round numbers are auditable
         # (VERDICT r3 weak #7 — r2->r3 batch/steps drift went unrecorded).
         # flash/remat appear only for models that CONSUMED the flags, so
@@ -214,6 +228,8 @@ def run_model(name: str, args) -> dict:
             "batch_per_chip": batch_per_chip,
             "steps": args.steps,
             "warmup": args.warmup,
+            "grad_accum": args.grad_accum,
+            "zero1": args.zero1,
             **(
                 {"flash": args.flash, "remat": args.remat}
                 if flags_apply
@@ -266,6 +282,12 @@ def main():
     parser.add_argument("--flash", default="auto",
                         choices=("auto", "on", "off"),
                         help="Pallas flash attention (LM models)")
+    parser.add_argument("--grad-accum", type=int, default=1,
+                        help="microbatches accumulated inside the step "
+                        "before ONE gradient collective (train/step.py)")
+    parser.add_argument("--zero1", action="store_true",
+                        help="ZeRO-1: reduce-scatter grads, shard the "
+                        "optimizer state over data, all-gather params")
     parser.add_argument("--lm-loss", default="fused",
                         choices=("fused", "dense"),
                         help="LM loss path: fused chunked-CE (default) or "
@@ -273,6 +295,8 @@ def main():
     args = parser.parse_args()
     if args.warmup < 1 or args.steps < 1:
         parser.error("--warmup and --steps must be >= 1")
+    if args.grad_accum < 1:
+        parser.error("--grad-accum must be >= 1")
     names = [args.model] if args.model else args.models.split(",")
     for n in names:
         if n not in BASELINES:

@@ -116,11 +116,16 @@ def compile_case(case) -> Tuple[object, object]:
 def collective_record(case, compiled) -> Dict[str, object]:
     """One budget-file entry for a compiled case."""
     text = compiled.as_text()
-    return {
+    record = {
         "mesh": {k: int(v) for k, v in dict(case.mesh.shape).items()},
         "global_batch": int(case.global_batch),
         "collectives": parse_collectives(text),
     }
+    if "zero1" in case.name.split("+"):
+        # structural contract, stronger than count/byte deltas: the gate
+        # additionally requires RS+AG to be PRESENT (see compare_budgets)
+        record["signature"] = "zero1-dp-step"
+    return record
 
 
 def compare_budgets(
@@ -128,6 +133,7 @@ def compare_budgets(
     measured: Dict[str, Dict[str, int]],
     byte_tolerance: float = DEFAULT_BYTE_TOLERANCE,
     config: Optional[str] = None,
+    signature: Optional[str] = None,
 ) -> Tuple[List[Finding], List[str]]:
     """(violations, notes) of a measured collective set vs its budget.
 
@@ -135,19 +141,53 @@ def compare_budgets(
     collective kind is both). Decreases are improvement notes — commit a
     budget refresh (``scripts/graft_lint.py --write-budgets``) to ratchet
     them in.
+
+    ``signature`` enforces a STRUCTURAL contract on top of the deltas.
+    ``"zero1-dp-step"`` (a ZeRO-1 config, Xu et al. arxiv 2004.13336):
+    gradient sync must stay reduce-scatter → all-gather; both kinds must
+    be present, whatever their counts did. Count/byte ratchets alone
+    cannot catch the failure mode where the whole decomposition collapses
+    back to all-reduce + full update (e.g. the optimizer state silently
+    re-replicated) while staying under a stale budget.
     """
     violations: List[Finding] = []
     notes: List[str] = []
+    if signature == "zero1-dp-step":
+        for kind in ("reduce-scatter", "all-gather"):
+            if measured.get(kind, {}).get("count", 0) == 0:
+                violations.append(Finding(
+                    rule="comm-zero1-signature",
+                    where=kind,
+                    message=(
+                        f"ZeRO-1 config compiled with NO {kind}: the "
+                        f"gradient sync must stay reduce-scatter + "
+                        f"all-gather (the sharded weight update of Xu et "
+                        f"al., arxiv 2004.13336). Its disappearance "
+                        f"usually means the optimizer state was silently "
+                        f"re-replicated (check dp_shard_opt_state and the "
+                        f"step's opt-state sharding constraint) and every "
+                        f"chip is back to the full-moment update."
+                    ),
+                    config=config,
+                ))
     for kind in sorted(set(committed) | set(measured)):
         c = committed.get(kind, {"count": 0, "bytes": 0})
         m = measured.get(kind, {"count": 0, "bytes": 0})
         if m["count"] > c["count"]:
+            extra = ""
+            if signature == "zero1-dp-step" and kind == "all-reduce":
+                extra = (
+                    " — on a ZeRO-1 config extra all-reduces usually mean "
+                    "part of the gradient tree fell off the "
+                    "reduce-scatter path (overlay floor, indivisible "
+                    "dims) or the opt state re-replicated"
+                )
             violations.append(Finding(
                 rule="comm-budget-count",
                 where=kind,
                 message=(
                     f"{kind} count {c['count']} -> {m['count']} "
-                    f"(+{m['count'] - c['count']})"
+                    f"(+{m['count'] - c['count']}){extra}"
                 ),
                 config=config,
             ))

@@ -1,6 +1,6 @@
 """Repo-specific Python AST lints (no jax import, no backend).
 
-Three rules, each a distilled past-regression class:
+Four rules, each a distilled past-regression class:
 
 - ``host-sync``: ``.item()`` / ``np.asarray`` / ``jax.device_get`` inside
   TRACED-SCOPE sources (``ops/``, ``models/``, ``parallel/``,
@@ -18,6 +18,13 @@ Three rules, each a distilled past-regression class:
   sanctioned pattern.
 - ``mutable-default``: ``[]``/``{}``/``set()`` defaults on public
   functions anywhere in the package.
+- ``bf16-accum``: a bfloat16 ``zeros``/``zeros_like``/``full``/``empty``
+  accumulator in a function that also ``scan``s, inside ``ops/`` or
+  ``train/`` — a loop-carried bf16 sum stops absorbing addends once the
+  running value outgrows them by ~2^8 (8-bit mantissa), so e.g. gradient
+  accumulation over microbatches silently loses the tail contributions.
+  Accumulate in f32 and cast once at the end (train/step.py's
+  accumulate_grads is the reference pattern).
 
 Scope is static and name-based, not a whole-program call graph — the
 cheap 99% of the check. Deliberate exceptions carry a
@@ -38,6 +45,9 @@ TRACED_SCOPE = (
     "ops/", "models/", "parallel/", "train/tasks.py", "train/step.py",
 )
 MESH_GUESS_SCOPE = ("ops/",)
+BF16_ACCUM_SCOPE = ("ops/", "train/")
+
+_ACCUM_CTORS = ("zeros", "zeros_like", "full", "empty")
 
 _SUPPRESS_RE = re.compile(r"#\s*graft-lint:\s*([\w,-]+)")
 
@@ -117,6 +127,61 @@ def _inspects_committed_sharding(func: ast.AST) -> bool:
             ):
                 return True
     return False
+
+
+def _is_bf16_expr(node: ast.AST) -> bool:
+    """Whether an expression names the bfloat16 dtype (``jnp.bfloat16``,
+    ``"bfloat16"``, a bare ``bfloat16`` name)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "bfloat16"
+    if isinstance(node, ast.Name):
+        return node.id == "bfloat16"
+    if isinstance(node, ast.Constant):
+        return node.value == "bfloat16"
+    return False
+
+
+def _bf16_accum_findings(
+    tree: ast.Module, relpath: str, supp: Dict[int, Set[str]]
+) -> List[Finding]:
+    """bf16 accumulator ctors in functions that also scan (module doc)."""
+    flagged: Dict[int, Finding] = {}  # keyed by line: nesting dedup
+    for func in ast.walk(tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        has_scan = False
+        ctors: List[ast.Call] = []
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name == "scan":
+                has_scan = True
+            elif name in _ACCUM_CTORS and any(
+                _is_bf16_expr(a)
+                for a in list(node.args)
+                + [k.value for k in node.keywords]
+            ):
+                ctors.append(node)
+        if not has_scan:
+            continue
+        for node in ctors:
+            if _suppressed(supp, node.lineno, "bf16-accum"):
+                continue
+            flagged.setdefault(node.lineno, Finding(
+                rule="bf16-accum",
+                where=f"{relpath}:{node.lineno}",
+                message=(
+                    "bfloat16 accumulator in a scanning function: a "
+                    "loop-carried bf16 sum drops addends ~2^8 smaller "
+                    "than the running value (8-bit mantissa) — "
+                    "accumulate in float32 and cast once after the loop"
+                ),
+            ))
+    return [flagged[k] for k in sorted(flagged)]
 
 
 def lint_source(relpath: str, source: str) -> List[Finding]:
@@ -243,6 +308,8 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
     visitor.visit_FunctionDef = visit_def
     visitor.visit_AsyncFunctionDef = visit_def
     visitor.visit(tree)
+    if _in_scope(relpath, BF16_ACCUM_SCOPE):
+        findings.extend(_bf16_accum_findings(tree, relpath, supp))
     return findings
 
 

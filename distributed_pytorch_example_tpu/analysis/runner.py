@@ -154,6 +154,9 @@ def audit_configs(
                 v, n = coll.compare_budgets(
                     committed["collectives"], record["collectives"],
                     byte_tolerance=byte_tolerance, config=name,
+                    signature=committed.get(
+                        "signature", record.get("signature")
+                    ),
                 )
                 if skew is not None:
                     result.notes.extend(

@@ -9,10 +9,21 @@ ZeRO-style optimizer sharding falls out for free.
 Rules are ``(regex, spec)`` where spec is a ``PartitionSpec`` or a callable
 ``(shape) -> PartitionSpec`` for shape-dependent placement (FSDP's
 "shard the largest divisible axis").
+
+ZeRO-1 (``dp_shard_opt_state=True``): optimizer-state leaves additionally
+shard over the ``data`` axis — the cross-replica weight-update sharding of
+Xu et al. (arxiv 2004.13336). The overlay composes with whatever the path
+rules chose (TP/SP/pipe axes stay where they are): each opt-state leaf gets
+``data`` on its LARGEST still-unsharded divisible dim, falling back to
+replicated below a size floor (tiny biases/scalars aren't worth a
+collective). Params themselves stay replicated over ``data`` — only the
+update is sharded; ``train/step.py`` reduce-scatters grads into this layout
+and all-gathers updated params back.
 """
 
 from __future__ import annotations
 
+import math
 import re
 from typing import Any, Callable, Optional, Sequence, Tuple, Union
 
@@ -94,13 +105,35 @@ def shard_largest_axis(axis_name: str, mesh: Mesh) -> Callable[[Tuple[int, ...]]
     return spec
 
 
+# opt-state leaves live under this prefix in the TrainState tree
+# (``opt_state/0/mu/...``); standalone opt-state trees pass the prefix to
+# ``tree_specs(path_prefix=...)`` explicitly
+_OPT_STATE_RE = re.compile(r"(^|/)opt_state(/|$)")
+
+# ZeRO-1 floor: opt-state leaves below this many ELEMENTS stay replicated
+# (64 KB at f32 — mirrors the XLA donation-aliasing floor rationale: a
+# reduce-scatter of a bias costs more in latency than its shard saves)
+DEFAULT_OPT_SHARD_MIN_SIZE = 1 << 14
+
+
 class Partitioner:
     """Assigns shardings to state pytrees and batches over a mesh."""
 
-    def __init__(self, mesh: Mesh, rules: Sequence[Rule] = (), default: SpecLike = P()):
+    def __init__(
+        self,
+        mesh: Mesh,
+        rules: Sequence[Rule] = (),
+        default: SpecLike = P(),
+        dp_shard_opt_state: bool = False,
+        opt_shard_axis: str = "data",
+        opt_shard_min_size: int = DEFAULT_OPT_SHARD_MIN_SIZE,
+    ):
         self.mesh = mesh
         self.rules = [(re.compile(pattern), spec) for pattern, spec in rules]
         self.default = default
+        self.dp_shard_opt_state = dp_shard_opt_state
+        self.opt_shard_axis = opt_shard_axis
+        self.opt_shard_min_size = opt_shard_min_size
         self._warned_fallbacks: set = set()  # one line per distinct cause
 
     def _fits(self, spec: P, shape: Tuple[int, ...]) -> bool:
@@ -125,6 +158,12 @@ class Partitioner:
         return True
 
     def spec_for(self, path: str, shape: Tuple[int, ...]) -> P:
+        base = self._base_spec(path, shape)
+        if self.dp_shard_opt_state and _OPT_STATE_RE.search(path):
+            return self.zero1_overlay(base, shape)
+        return base
+
+    def _base_spec(self, path: str, shape: Tuple[int, ...]) -> P:
         for pattern, spec in self.rules:
             if pattern.search(path):
                 s = spec(shape) if callable(spec) else spec
@@ -143,6 +182,61 @@ class Partitioner:
         if s != P():
             self._warn_fallback(path, s, shape, "default")
         return P()
+
+    # -- ZeRO-1 overlay ----------------------------------------------------
+
+    def zero1_overlay(self, spec: P, shape: Tuple[int, ...]) -> P:
+        """``spec`` with the ``data`` axis added on the overlay dim (if any).
+
+        Composes with the base rules: TP/SP/pipe placements are untouched;
+        ``data`` lands on the LARGEST dim the base spec leaves unsharded
+        whose extent the axis size divides. Leaves below the element floor,
+        with no divisible free dim, or already touching the axis stay as-is
+        (their grads all-reduce and their moments replicate — correct,
+        just unsharded).
+        """
+        dim = self.zero1_dim(spec, shape)
+        if dim is None:
+            return spec
+        entries = list(spec) + [None] * (len(shape) - len(spec))
+        entries[dim] = self.opt_shard_axis
+        return P(*entries)
+
+    def zero1_dim(self, spec: P, shape: Tuple[int, ...]) -> Optional[int]:
+        """The dim ``zero1_overlay`` would shard, or None (stays as-is)."""
+        if not self.dp_shard_opt_state or not shape:
+            return None
+        size = self.mesh.shape.get(self.opt_shard_axis, 1)
+        if size <= 1 or math.prod(shape) < self.opt_shard_min_size:
+            return None
+        entries = list(spec) + [None] * (len(shape) - len(spec))
+        for entry in entries:
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            if self.opt_shard_axis in axes:
+                return None  # base rules already placed the axis
+        best = None
+        for dim, extent in enumerate(shape):
+            if entries[dim] is None and extent % size == 0 and (
+                best is None or extent > shape[best]
+            ):
+                best = dim
+        return best
+
+    def zero1_dims(self, params: Any) -> Any:
+        """Per-PARAM-leaf overlay dims (None = all-reduce/replicated leaf).
+
+        Drives the step's gradient reduce-scatter: grads mirror the param
+        tree, so the dim that shards a param's optimizer moments is the
+        scatter dimension of that param's gradient collective.
+        """
+
+        def leaf_dim(path, leaf):
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            return self.zero1_dim(self._base_spec(_path_str(path), shape), shape)
+
+        return jax.tree_util.tree_map_with_path(leaf_dim, params)
 
     def _warn_fallback(self, path, spec, shape, kind: str) -> None:
         from distributed_pytorch_example_tpu.runtime.logging import get_logger
@@ -168,18 +262,26 @@ class Partitioner:
             "the default" if kind == "rule" else "P()",
         )
 
-    def tree_specs(self, tree: Any) -> Any:
-        """PartitionSpec per leaf (tree may hold arrays or ShapeDtypeStructs)."""
+    def tree_specs(self, tree: Any, path_prefix: str = "") -> Any:
+        """PartitionSpec per leaf (tree may hold arrays or ShapeDtypeStructs).
+
+        ``path_prefix`` scopes path-sensitive policies for SUBTREES handed
+        in standalone: a bare opt-state tree has paths like ``0/mu/...``,
+        so the ZeRO-1 overlay only engages when the caller prepends
+        ``"opt_state/"`` (the step does, when re-constraining the updated
+        optimizer state).
+        """
 
         def leaf_spec(path, leaf):
             shape = tuple(getattr(leaf, "shape", ()) or ())
-            return self.spec_for(_path_str(path), shape)
+            return self.spec_for(path_prefix + _path_str(path), shape)
 
         return jax.tree_util.tree_map_with_path(leaf_spec, tree)
 
-    def tree_shardings(self, tree: Any) -> Any:
+    def tree_shardings(self, tree: Any, path_prefix: str = "") -> Any:
         return jax.tree_util.tree_map(
-            lambda s: NamedSharding(self.mesh, s), self.tree_specs(tree)
+            lambda s: NamedSharding(self.mesh, s),
+            self.tree_specs(tree, path_prefix=path_prefix),
         )
 
     def batch_spec(self) -> P:
@@ -197,14 +299,24 @@ class Partitioner:
         return jax.device_put(tree, self.tree_shardings(tree))
 
 
-def data_parallel(mesh: Mesh) -> Partitioner:
+def data_parallel(
+    mesh: Mesh,
+    dp_shard_opt_state: bool = False,
+    opt_shard_min_size: int = DEFAULT_OPT_SHARD_MIN_SIZE,
+) -> Partitioner:
     """Pure DP: everything replicated; batch on (data, fsdp).
 
     Semantics parity with the reference: params identical on every replica,
     gradients mean-reduced across the data axes each step (DDP default,
-    train.py:233).
+    train.py:233). ``dp_shard_opt_state=True`` flips the update to ZeRO-1:
+    grads reduce-scatter, optimizer state shards over ``data``, updated
+    params all-gather back (see module docstring).
     """
-    return Partitioner(mesh, rules=(), default=P())
+    return Partitioner(
+        mesh, rules=(), default=P(),
+        dp_shard_opt_state=dp_shard_opt_state,
+        opt_shard_min_size=opt_shard_min_size,
+    )
 
 
 def fsdp(mesh: Mesh, axis: str = "fsdp") -> Partitioner:

@@ -24,6 +24,7 @@ from __future__ import annotations
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_pytorch_example_tpu.parallel.api import (
+    DEFAULT_OPT_SHARD_MIN_SIZE,
     Partitioner,
     Rule,
     shard_largest_axis,
@@ -68,12 +69,22 @@ TRANSFORMER_TP_RULES: tuple = (
 def transformer_partitioner(
     mesh: Mesh,
     fsdp_rest: bool = False,
+    dp_shard_opt_state: bool = False,
+    opt_shard_min_size: int = DEFAULT_OPT_SHARD_MIN_SIZE,
 ) -> Partitioner:
     """TP rules for transformer blocks; remaining params replicated or FSDP.
 
     ``fsdp_rest=True`` composes TP with ZeRO-style sharding: any leaf not
     matched by a TP rule (embeddings, norms, conv stems) is sharded along its
     largest dim on the ``fsdp`` axis.
+
+    ``dp_shard_opt_state=True`` is the ZeRO-1 weight-update mode: the TP
+    rules above still place the ``tensor``/``pipe``/``expert`` axes, and
+    optimizer-state leaves ADDITIONALLY shard their largest free dim over
+    ``data`` (parallel/api.py ``zero1_overlay``) — e.g. an attention kernel's
+    Adam moments go ``P(None, 'tensor')`` → ``P('data', 'tensor')``. Params
+    stay replicated over ``data``; the step reduce-scatters grads into this
+    layout and all-gathers updated params (train/step.py).
 
     Vocab parallelism: token-embedding tables and untied LM heads shard
     their vocab dim on ``tensor`` when it divides — the embedding gather
@@ -103,4 +114,8 @@ def transformer_partitioner(
         (r"(wte|tok_embed)/embedding$", vocab_embed),
         (r"lm_head$", vocab_head),
     ]
-    return Partitioner(mesh, rules=rules, default=default)
+    return Partitioner(
+        mesh, rules=rules, default=default,
+        dp_shard_opt_state=dp_shard_opt_state,
+        opt_shard_min_size=opt_shard_min_size,
+    )

@@ -23,6 +23,10 @@ from distributed_pytorch_example_tpu.train.checkpoint import (  # noqa: F401
     load_checkpoint,
     save_checkpoint,
 )
+from distributed_pytorch_example_tpu.train.optimizers import (  # noqa: F401
+    make_optimizer,
+    opt_state_bytes_per_chip,
+)
 from distributed_pytorch_example_tpu.train.loop import (  # noqa: F401
     PreemptionInterrupt,
     Trainer,

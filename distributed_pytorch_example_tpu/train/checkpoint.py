@@ -42,6 +42,12 @@ introspectable), auto-detected on load:
   barrier) and atomically flipping a pointer file. The loader reassembles
   global leaves and re-shards onto the target mesh, so a checkpoint saved
   under one mesh shape restores under any other.
+
+Both formats restore through ``state_shardings`` (device_put to the
+TARGET layout), so gradient-sync mode flips across resume for free: a
+checkpoint written replicated restores into a ZeRO-1 run (moments get
+sharded over ``data`` on load) and vice versa (shards reassemble to full
+leaves, then replicate) — pinned by tests/test_zero1.py round-trips.
 """
 
 from __future__ import annotations

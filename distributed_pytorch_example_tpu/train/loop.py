@@ -71,6 +71,7 @@ class Trainer:
         profile_window: tuple = (10, 13),
         checkpoint_format: str = "auto",
         save_every_steps: int = 0,
+        grad_accum_steps: int = 1,
     ):
         self.model = model
         self.task = task
@@ -79,7 +80,19 @@ class Trainer:
         self.checkpoint_dir = checkpoint_dir
         self.log_every = log_every
         self.seed = seed
-        self.train_step = build_train_step(model, task, optimizer)
+        if grad_accum_steps < 1:
+            raise ValueError(
+                f"grad_accum_steps must be >= 1, got {grad_accum_steps}"
+            )
+        # N>1: the step scans N microbatches of batch/N samples before ONE
+        # deferred gradient collective (train/step.py) — in-step counterpart
+        # of the optimizer-level optax.MultiSteps every_k (which pays the
+        # gradient sync on every micro-step)
+        self.grad_accum_steps = grad_accum_steps
+        self.train_step = build_train_step(
+            model, task, optimizer,
+            partitioner=partitioner, grad_accum_steps=grad_accum_steps,
+        )
         self.eval_step = build_eval_step(model, task)
         self.state: Optional[TrainState] = None
         self.state_shardings = None

@@ -9,7 +9,14 @@ standard training-science stack from optax primitives:
 - global-norm gradient clipping;
 - gradient accumulation (``every_k``): optax.MultiSteps wraps the update so
   k micro-steps accumulate before one optimizer step — the large-batch
-  lever when HBM caps the per-step batch.
+  lever when HBM caps the per-step batch. NOTE: each micro-step still pays
+  the gradient collective; ``Trainer(grad_accum_steps=N)`` accumulates
+  INSIDE the jitted step and syncs once (train/step.py) — prefer it on
+  multi-chip meshes.
+
+Under ZeRO-1 (``dp_shard_opt_state``, parallel/api.py) the optimizer state
+built here shards over ``data`` path-by-path; ``opt_state_bytes_per_chip``
+below measures the resulting per-chip footprint (the ≈1/D memory win).
 
 Everything returns a single ``optax.GradientTransformation`` consumed
 unchanged by ``train.step`` — accumulation state lives inside the optimizer
@@ -20,6 +27,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import optax
 
 from distributed_pytorch_example_tpu.runtime.logging import get_logger
@@ -110,5 +118,34 @@ def make_optimizer(
     parts.append(opt)
     tx = optax.chain(*parts) if len(parts) > 1 else opt
     if every_k > 1:
+        logger.warning(
+            "every_k=%d uses optax.MultiSteps: the gradient collective "
+            "fires on EVERY micro-step; Trainer(grad_accum_steps=%d) "
+            "accumulates inside the compiled step and syncs once",
+            every_k, every_k,
+        )
         tx = optax.MultiSteps(tx, every_k_schedule=every_k)
     return tx
+
+
+def opt_state_bytes_per_chip(opt_state) -> int:
+    """Bytes of optimizer state resident on ONE chip (addressable shards).
+
+    The ZeRO-1 observable: with ``dp_shard_opt_state`` this shrinks by
+    ≈ the data-parallel degree versus the replicated update, where every
+    chip holds the full moments. Abstract leaves (ShapeDtypeStruct) count
+    their full (replicated) size.
+    """
+    dev = jax.devices()[0]
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(opt_state):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            for s in shards:
+                if s.device == dev:
+                    total += int(s.data.size) * s.data.dtype.itemsize
+        else:
+            size = int(getattr(leaf, "size", 0) or 0)
+            dtype = getattr(leaf, "dtype", None)
+            total += size * (dtype.itemsize if dtype is not None else 0)
+    return total

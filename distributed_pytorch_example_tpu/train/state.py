@@ -5,6 +5,12 @@ equivalent of the reference's (DDP model, optimizer) object pair
 (reference train.py:232-249). Keeping optimizer state and mutable model
 state (batch stats) inside one donated pytree lets XLA update everything
 in-place in a single compiled step.
+
+Placement is the partitioner's job, path-by-path (parallel/api.py): under
+ZeRO-1 (``dp_shard_opt_state``) the ``opt_state/...`` leaves shard over
+``data`` while ``params/...`` stay replicated across it — the two subtrees
+of ONE state deliberately disagree about the data axis, and the step's
+reduce-scatter/all-gather pair (train/step.py) bridges them every update.
 """
 
 from __future__ import annotations

@@ -1,10 +1,37 @@
 """Jit-compiled train/eval steps and sharded state initialization.
 
 The train step is the whole distributed program: forward, backward, gradient
-all-reduce (inserted by XLA from the batch's data-axis sharding — the
-compiled equivalent of DDP's bucketed backward hooks, reference
-train.py:233,138), optimizer update. The input state is donated so params
-and optimizer moments update in place in HBM.
+collective, optimizer update. The input state is donated so params and
+optimizer moments update in place in HBM.
+
+Gradient-sync modes over the ``data`` axis (the reference's DDP surface,
+reference train.py:233,138):
+
+- replicated (default): the gradient all-reduce is inserted by XLA from the
+  batch's data-axis sharding — the compiled equivalent of DDP's bucketed
+  backward hooks — and every chip runs the full optax update on full
+  optimizer state.
+- ZeRO-1 (``partitioner.dp_shard_opt_state``): the all-reduce is decomposed
+  into reduce-scatter → sharded update → all-gather (Xu et al., arxiv
+  2004.13336). Each chip reduce-scatters 1/D of every gradient, updates the
+  1/D optimizer-state shard the partitioner's overlay assigns it
+  (parallel/api.py ``zero1_overlay``), and the updated params all-gather
+  back to replicated. Same wire bytes as a ring all-reduce (RS + AG), but
+  weight-update FLOPs and optimizer memory shrink by the data-parallel
+  degree D.
+- ``grad_accum_steps=N``: microbatch accumulation INSIDE the jitted step —
+  a ``lax.scan`` over N microbatches accumulates f32 grads locally and the
+  gradient collective fires ONCE per step, after the scan (not once per
+  microbatch), so large effective batches pay the sync once.
+
+ZeRO-1 and accumulation share one mechanism: the loss/backward runs in a
+``shard_map`` manual over {``data``} (every other mesh axis stays under
+automatic GSPMD, so TP rules compose unchanged) and the gradient collective
+is an EXPLICIT ``psum_scatter``/``psum``. This is deliberate: relying on
+sharding constraints alone lets the partitioner lower the partial-sum →
+tiled reshard as all-reduce + dynamic-slice (the CPU backend always does;
+TPU needs the ReduceScatterCreator pass to fire), whereas the explicit
+collective IS a reduce-scatter in the compiled HLO on every backend.
 """
 
 from __future__ import annotations
@@ -31,6 +58,8 @@ def init_state(
     Initialization runs under jit with ``out_shardings`` derived from the
     partition rules, so large sharded params are *born* sharded — no host
     materialization of the full model (essential for FSDP/TP configs).
+    Under ZeRO-1 the optimizer state is likewise born sharded over ``data``
+    (the overlay engages on the ``opt_state/...`` paths of the state tree).
 
     Returns (state, state_shardings) — shardings are reused by the step jit
     and by checkpoint restore.
@@ -67,21 +96,230 @@ def init_state(
     return state, shardings
 
 
-def build_train_step(model, task, optimizer: optax.GradientTransformation):
-    """One compiled optimization step: (state, batch) -> (state, metrics)."""
+def _split_microbatches(batch, n: int):
+    """Reshape every batch leaf (B, ...) -> (n, B/n, ...) for the scan."""
+
+    def split(x):
+        b = x.shape[0]
+        if b % n:
+            raise ValueError(
+                f"grad_accum_steps={n} must divide the per-data-shard "
+                f"batch size {b} (batch leaf shape {x.shape})"
+            )
+        return x.reshape((n, b // n) + x.shape[1:])
+
+    return jax.tree_util.tree_map(split, batch)
+
+
+def _mean_metrics(metrics):
+    """Mean the scan-stacked (N, ...) per-microbatch metrics."""
+    return jax.tree_util.tree_map(lambda m: jnp.mean(m, axis=0), metrics)
+
+
+def _pmean_inexact(tree, axis: str):
+    """pmean float leaves over ``axis``; pass integral leaves through
+    (batch counters are identical on every shard by construction)."""
+
+    def one(x):
+        if jnp.issubdtype(jnp.result_type(x), jnp.inexact):
+            return jax.lax.pmean(x, axis)
+        return x
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+def build_train_step(
+    model,
+    task,
+    optimizer: optax.GradientTransformation,
+    partitioner: Optional[Partitioner] = None,
+    grad_accum_steps: int = 1,
+):
+    """One compiled optimization step: (state, batch) -> (state, metrics).
+
+    ``partitioner`` selects the gradient-sync mode (module docstring); with
+    the default replicated mode and ``grad_accum_steps=1`` the compiled
+    program is byte-identical to the historical step. ``grad_accum_steps=N``
+    scans N microbatches before ONE deferred gradient collective.
+    """
+    if grad_accum_steps < 1:
+        raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+    zero1 = bool(partitioner is not None and partitioner.dp_shard_opt_state)
+    # Both new modes need the data axis MANUAL: ZeRO-1 for the explicit
+    # reduce-scatter, accumulation so the per-microbatch backward carries
+    # no implicit data collective inside the scan (XLA's while-loop
+    # all-reduce motion would have to hoist it; manual mode never emits it)
+    manual_data = partitioner is not None and (zero1 or grad_accum_steps > 1)
+
+    def compute_loss_grads(params, model_state, batch, rng):
+        """Local (or global, in automatic mode) grads + metrics + new
+        model_state, with the f32 accumulation contract applied."""
+
+        def loss_fn(p):
+            loss, metrics, new_ms = task.compute_loss(
+                model, p, model_state, batch, rng, train=True
+            )
+            return loss, (metrics, new_ms)
+
+        grads, (metrics, new_ms) = jax.grad(loss_fn, has_aux=True)(params)
+        # f32 island: under a mixed-precision policy microbatch grads can
+        # arrive bf16; summing those across microbatches collapses after
+        # ~256 increments (8-bit mantissa), so the accumulator contract is
+        # cast-then-add (the bf16-accum graft-lint rule guards the pattern)
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads
+        )
+        return grads, metrics, new_ms
+
+    def accumulate_grads(params, model_state, batch, rng):
+        """lax.scan over microbatches: f32 grad sum, stacked metrics."""
+        micro = _split_microbatches(batch, grad_accum_steps)
+        acc0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+
+        def scan_body(carry, idx_mb):
+            ms, acc = carry
+            idx, mb = idx_mb
+            g, metrics, ms = compute_loss_grads(
+                params, ms, mb, jax.random.fold_in(rng, idx)
+            )
+            acc = jax.tree_util.tree_map(jnp.add, acc, g)
+            return (ms, acc), metrics
+
+        # unroll=N (full): a rolled while op inside the data-manual region
+        # hard-crashes the 0.4.x SPMD partitioner (Check failed:
+        # sharding.IsManualSubgroup() partitioning the loop carry); the
+        # unrolled scan keeps the accumulate-then-sync structure with no
+        # while op, at compile time linear in N (N is single-digit)
+        (new_ms, grads), metrics = jax.lax.scan(
+            scan_body,
+            (model_state, acc0),
+            (jnp.arange(grad_accum_steps), micro),
+            unroll=grad_accum_steps,
+        )
+        return grads, _mean_metrics(metrics), new_ms
+
+    def manual_grads(params, model_state, batch, rng):
+        """Grads via a data-manual shard_map: each shard runs its local
+        (micro)batches, then ONE explicit collective per param leaf —
+        psum_scatter into the ZeRO-1 layout where the optimizer state is
+        sharded, psum where it stays replicated."""
+        from distributed_pytorch_example_tpu.runtime import jax_compat
+        from jax.sharding import PartitionSpec as P
+
+        mesh = partitioner.mesh
+        dsize = mesh.shape.get("data", 1)
+        if zero1:
+            dims = partitioner.zero1_dims(params)
+        else:
+            dims = jax.tree_util.tree_map(lambda _: None, params)
+        is_dim_leaf = lambda d: d is None  # noqa: E731 - tree of Optional[int]
+
+        def body(params, model_state, batch, shard_id, rng):
+            # per-shard rng WITHOUT lax.axis_index (that lowers to a
+            # PartitionId op pre-0.9 SPMD cannot partition — the known
+            # pipe-config gap): the shard id rides in as the local slice
+            # of an arange sharded over 'data'. Decorrelates dropout/MLM
+            # masking draws across data shards.
+            rng = jax.random.fold_in(rng, shard_id[0])
+            if grad_accum_steps > 1:
+                grads, metrics, new_ms = accumulate_grads(
+                    params, model_state, batch, rng
+                )
+            else:
+                grads, metrics, new_ms = compute_loss_grads(
+                    params, model_state, batch, rng
+                )
+
+            # the ONE deferred gradient collective per step: local grads
+            # are d(local mean loss), so the global mean gradient is
+            # psum(...) / (data span * microbatch count)
+            scale = 1.0 / (dsize * grad_accum_steps)
+
+            def sync(dim, g):
+                if dim is not None:
+                    g = jax.lax.psum_scatter(
+                        g, "data", scatter_dimension=dim, tiled=True
+                    )
+                else:
+                    g = jax.lax.psum(g, "data")
+                return g * scale
+
+            grads = jax.tree_util.tree_map(
+                sync, dims, grads, is_leaf=is_dim_leaf
+            )
+            # loss/accuracy become means over the GLOBAL batch (equal
+            # shard sizes by the sampler's padding contract — same
+            # reduction the replicated path's global mean computes)
+            metrics = jax.tree_util.tree_map(
+                lambda m: jax.lax.pmean(m.astype(jnp.float32), "data"),
+                metrics,
+            )
+            new_ms = _pmean_inexact(new_ms, "data")
+            return grads, metrics, new_ms
+
+        def grad_out_spec(dim, g):
+            if dim is None:
+                return P()
+            entries: list = [None] * g.ndim
+            entries[dim] = "data"
+            return P(*entries)
+
+        grad_out_specs = jax.tree_util.tree_map(
+            grad_out_spec, dims, params, is_leaf=is_dim_leaf
+        )
+        shard_ids = jnp.arange(max(dsize, 1), dtype=jnp.int32)
+        mapped = jax_compat.shard_map(
+            body,
+            mesh,
+            in_specs=(P(), P(), P(("data",)), P("data"), P()),
+            out_specs=(grad_out_specs, P(), P()),
+            axis_names={"data"},
+        )
+        return mapped(params, model_state, batch, shard_ids, rng)
 
     def train_step(state: TrainState, batch):
         step_rng = jax.random.fold_in(state.rng, state.step)
 
-        def loss_fn(params):
-            loss, metrics, new_ms = task.compute_loss(
-                model, params, state.model_state, batch, step_rng, train=True
+        if manual_data:
+            grads, metrics, new_ms = manual_grads(
+                state.params, state.model_state, batch, step_rng
             )
-            return loss, (metrics, new_ms)
+        elif grad_accum_steps > 1:
+            # no partitioner: automatic-mode accumulation (single-chip or
+            # GSPMD-managed; any implied data collective repeats per
+            # microbatch — use a partitioner to get the deferred form)
+            grads, metrics, new_ms = accumulate_grads(
+                state.params, state.model_state, batch, step_rng
+            )
+            grads = jax.tree_util.tree_map(
+                lambda g: g / grad_accum_steps, grads
+            )
+        else:
+            grads, metrics, new_ms = compute_loss_grads(
+                state.params, state.model_state, batch, step_rng
+            )
 
-        grads, (metrics, new_ms) = jax.grad(loss_fn, has_aux=True)(state.params)
-        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
         new_params = optax.apply_updates(state.params, updates)
+        if zero1:
+            # pin the ZeRO-1 layout: the sharded-gradient update must KEEP
+            # the moments sharded (a propagation choice to replicate them
+            # would silently undo the memory win — the comm-budget gate
+            # also watches for this), and the updated params re-replicate
+            # over 'data' — this constraint IS the ZeRO-1 all-gather
+            new_params = jax.lax.with_sharding_constraint(
+                new_params, partitioner.tree_shardings(new_params)
+            )
+            new_opt_state = jax.lax.with_sharding_constraint(
+                new_opt_state,
+                partitioner.tree_shardings(
+                    new_opt_state, path_prefix="opt_state/"
+                ),
+            )
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,

@@ -12,6 +12,12 @@ the r4 LM-MFU residual analysis in results/lm_mfu_analysis/).
 Usage:
     python scripts/profile_step.py --model gpt2 --seq-len 1024 --batch 16
     python scripts/profile_step.py --seq-len 16384 --batch 1 --remat
+    python scripts/profile_step.py --zero1 --grad-accum 4  # RS+AG sync
+
+Before tracing, prints the compiled step's collective mix (kind, count,
+result bytes) to stderr — the quick check that the gradient sync is the
+one you asked for (ZeRO-1: reduce-scatter + all-gather, no gradient
+all-reduce; replicated: all-reduce).
 """
 
 from __future__ import annotations
@@ -37,6 +43,11 @@ def main():
     parser.add_argument("--image-size", type=int, default=32)
     parser.add_argument("--num-classes", type=int, default=10)
     parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--zero1", action="store_true",
+                        help="ZeRO-1 gradient sync (reduce-scatter + "
+                        "sharded update + all-gather)")
+    parser.add_argument("--grad-accum", type=int, default=1,
+                        help="in-step microbatch accumulation")
     parser.add_argument("--trace-dir", default="/tmp/profile_step")
     parser.add_argument("--trace-steps", type=int, default=3)
     parser.add_argument("--top", type=int, default=30)
@@ -85,9 +96,12 @@ def main():
         }
         sample_key = "tokens"
     mesh = dpx.runtime.make_mesh()
-    partitioner = dpx.parallel.data_parallel(mesh)
+    partitioner = dpx.parallel.data_parallel(
+        mesh, dp_shard_opt_state=args.zero1
+    )
     trainer = dpx.train.Trainer(
-        model, task, optax.adam(1e-3), partitioner=partitioner
+        model, task, optax.adam(1e-3), partitioner=partitioner,
+        grad_accum_steps=args.grad_accum,
     )
     batch = {
         k: jax.make_array_from_process_local_data(
@@ -98,6 +112,20 @@ def main():
     with mesh:
         trainer.init(batch[sample_key])
         compiled = trainer.train_step.lower(trainer.state, batch).compile()
+        # what the gradient sync compiled to — ZeRO-1 should show
+        # reduce-scatter + all-gather, replicated mode all-reduce only
+        from distributed_pytorch_example_tpu.analysis.collectives import (
+            parse_collectives,
+        )
+
+        comms = parse_collectives(compiled.as_text())
+        print("step collectives (kind: count / result bytes):",
+              file=sys.stderr)
+        for kind, rec in sorted(comms.items()):
+            print(f"  {kind}: {rec['count']} / {rec['bytes']}",
+                  file=sys.stderr)
+        if not comms:
+            print("  (none — single-device program)", file=sys.stderr)
         state = trainer.state
         metrics = None
         for _ in range(3):
