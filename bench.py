@@ -48,37 +48,9 @@ BASELINES = {
 }
 DEFAULT_MODELS = ("resnet18", "resnet50", "vit-b16", "bert-base", "gpt2")
 
-# peak dense bf16 FLOP/s per chip by PJRT device_kind substring
-PEAK_BF16 = {
-    "v4": 275e12,
-    "v5e": 197e12,
-    "v5 lite": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-    "v6 lite": 918e12,
-}
-
-
-def _peak_flops(device) -> float | None:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, peak in PEAK_BF16.items():
-        if key in kind:
-            return peak
-    return None
-
-
-def _cost_flops(compiled) -> float | None:
-    """XLA's FLOP estimate for a compiled (per-device, SPMD-partitioned)
-    executable — one device's share of the step."""
-    try:
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, (list, tuple)):
-            analysis = analysis[0]
-        return float(analysis["flops"])
-    except Exception as e:  # noqa: BLE001 - cost analysis is best-effort
-        print(f"bench: cost_analysis unavailable ({e})", file=sys.stderr)
-        return None
-
+# peak-FLOPs table and the compiled cost/memory accounting now live in
+# telemetry/cost.py (graft-scope's compile-time cost registry); bench
+# consumes the same record the Trainer registers at each compile
 
 def run_model(name: str, args) -> dict:
     import jax
@@ -191,7 +163,14 @@ def run_model(name: str, args) -> dict:
         # AOT-compile once and drive the SAME executable for warmup and the
         # timed loop (a separate jit call would compile a second copy)
         step = trainer.train_step.lower(trainer.state, batch).compile()
-        flops_per_step = _cost_flops(step)
+        from distributed_pytorch_example_tpu.telemetry import (
+            compiled_cost_record,
+        )
+
+        cost = compiled_cost_record(step, jax.devices()[0])
+        flops_per_step = cost["flops_per_step_per_device"]
+        if flops_per_step is None:
+            print("bench: cost_analysis unavailable", file=sys.stderr)
         state = trainer.state
         for _ in range(args.warmup):
             state, metrics = step(state, batch)
@@ -214,12 +193,17 @@ def run_model(name: str, args) -> dict:
     else:
         rate = samples_per_sec / n_chips
         unit = "samples/sec/chip"
+    step_time_ms = elapsed / args.steps * 1000.0
     result = {
         "metric": f"{name.replace('-', '_')}_{unit_kind}_per_sec_per_chip",
         "value": round(rate, 2),
         "unit": unit,
         "vs_baseline": round(rate / baseline, 3),
         "opt_state_bytes_per_chip": opt_bytes,
+        "step_time_ms": round(step_time_ms, 3),
+        # compiler-reported HBM residency of the step (args+out+temps−alias;
+        # telemetry/cost.py) — None when the backend can't answer
+        "hbm_peak_bytes": cost["hbm_peak_bytes"],
         # self-describing config: round-over-round numbers are auditable
         # (VERDICT r3 weak #7 — r2->r3 batch/steps drift went unrecorded).
         # flash/remat appear only for models that CONSUMED the flags, so
@@ -242,7 +226,7 @@ def run_model(name: str, args) -> dict:
             ),
         },
     }
-    peak = _peak_flops(jax.devices()[0])
+    peak = cost.get("peak_bf16_flops")
     if flops_per_step is not None and peak is not None:
         # cost_analysis is of the per-device partitioned executable, so
         # this is already per-chip utilization — no n_chips division.
@@ -253,6 +237,13 @@ def run_model(name: str, args) -> dict:
         util = round(flops_per_step * steps_per_sec / peak, 4)
         result["hfu" if (args.remat and flags_apply) else "mfu"] = util
         result["flops_per_step_per_chip"] = flops_per_step
+    # same quantity graft-scope logs per step (CostRegistry.mfu_analytic):
+    # XLA-counted FLOPs / measured step time / peak bf16; null off-TPU
+    result["mfu_analytic"] = (
+        round(flops_per_step / (step_time_ms / 1000.0) / peak, 4)
+        if flops_per_step is not None and peak is not None
+        else None
+    )
     print(
         f"bench: {name}: {elapsed:.2f}s for {args.steps} steps "
         f"({samples_per_sec:.1f} samples/s total)",

@@ -1,6 +1,6 @@
 """Repo-specific Python AST lints (no jax import, no backend).
 
-Four rules, each a distilled past-regression class:
+Five rules, each a distilled past-regression class:
 
 - ``host-sync``: ``.item()`` / ``np.asarray`` / ``jax.device_get`` inside
   TRACED-SCOPE sources (``ops/``, ``models/``, ``parallel/``,
@@ -25,6 +25,13 @@ Four rules, each a distilled past-regression class:
   accumulation over microbatches silently loses the tail contributions.
   Accumulate in f32 and cast once at the end (train/step.py's
   accumulate_grads is the reference pattern).
+- ``debug-callback``: ``jax.debug.print`` / ``jax.debug.callback`` inside
+  ``ops/`` or ``train/step.py``. Debug callbacks schedule a host callback
+  per step — a hidden device->host round-trip in the hot path (the exact
+  cost class the host-sync rule exists for) that also blocks donation and
+  perturbs XLA scheduling. Step telemetry goes through the graft-scope
+  sentinel struct (``telemetry/sentinels.py``): on-device scalars fetched
+  once per log boundary.
 
 Scope is static and name-based, not a whole-program call graph — the
 cheap 99% of the check. Deliberate exceptions carry a
@@ -46,6 +53,7 @@ TRACED_SCOPE = (
 )
 MESH_GUESS_SCOPE = ("ops/",)
 BF16_ACCUM_SCOPE = ("ops/", "train/")
+DEBUG_CALLBACK_SCOPE = ("ops/", "train/step.py")
 
 _ACCUM_CTORS = ("zeros", "zeros_like", "full", "empty")
 
@@ -202,6 +210,7 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
     findings: List[Finding] = []
     traced = _in_scope(relpath, TRACED_SCOPE)
     mesh_scope = _in_scope(relpath, MESH_GUESS_SCOPE)
+    debug_scope = _in_scope(relpath, DEBUG_CALLBACK_SCOPE)
 
     visitor = _FuncStack()
     sharding_aware: Dict[ast.AST, bool] = {}
@@ -240,6 +249,34 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
                     message=f"{ast.unparse(fn)}(...) materializes on host "
                             "inside traced scope",
                 ))
+        if debug_scope:
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in (
+                "print", "callback"
+            ):
+                owner = fn.value
+                # jax.debug.print / debug.print (from jax import debug)
+                is_jax_debug = (
+                    isinstance(owner, ast.Attribute)
+                    and owner.attr == "debug"
+                    and _attr_root(owner) in aliases["jax"]
+                ) or (
+                    isinstance(owner, ast.Name) and owner.id == "debug"
+                )
+                if is_jax_debug and not _suppressed(
+                    supp, node.lineno, "debug-callback"
+                ):
+                    findings.append(Finding(
+                        rule="debug-callback",
+                        where=f"{relpath}:{node.lineno}",
+                        message=(
+                            f"{ast.unparse(fn)}(...) schedules a host "
+                            "callback per step inside the compiled hot "
+                            "path; route step telemetry through the "
+                            "graft-scope sentinel struct "
+                            "(telemetry/sentinels.py) instead"
+                        ),
+                    ))
         if mesh_scope:
             fn = node.func
             name = fn.attr if isinstance(fn, ast.Attribute) else (

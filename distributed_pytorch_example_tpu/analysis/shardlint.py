@@ -36,6 +36,10 @@ DEFAULT_UPCAST_ALLOWLIST: Tuple[str, ...] = (
     r"chunked_ce\.py",             # the fused CE's own f32 accumulation
     r"metrics",                    # metric sums
     r"train/(tasks|step)\.py",     # loss reduction / metric assembly
+    # graft-scope sentinels: param/grad-norm squares accumulate in f32 by
+    # contract (telemetry/sentinels.py global_norm) — large bf16 param
+    # leaves upcast once per step inside the compiled step
+    r"telemetry/sentinels\.py",
     r"ops/attention\.py",          # deliberate f32 softmax (commented)
     # flax layers under the mixed-precision policy: f32 master params are
     # cast to bf16 compute, so AD emits a bf16->f32 convert per kernel

@@ -86,6 +86,10 @@ class DeviceLoader:
         )
         self.drop_last = drop_last
         self.prefetch = prefetch
+        # graft-scope hook: Trainer.fit attaches its Telemetry scope here so
+        # host->device transfers emit "h2d" trace spans (the prefetch
+        # thread's track in the trace); None = no tracing
+        self.telemetry = None
         if drop_last:
             self.steps_per_epoch = len(self.sampler) // self.local_batch_size
         else:
@@ -118,14 +122,23 @@ class DeviceLoader:
             yield _get_batch(self.dataset, indices[lo : lo + self.local_batch_size])
 
     def _to_device(self, host_batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        import contextlib
+
         import jax
 
-        if self._sharding is not None:
-            return {
-                k: jax.make_array_from_process_local_data(self._sharding, v)
-                for k, v in host_batch.items()
-            }
-        return {k: jax.device_put(v) for k, v in host_batch.items()}
+        scope = self.telemetry
+        span = scope.span("h2d") if scope is not None else (
+            contextlib.nullcontext()
+        )
+        with span:
+            if self._sharding is not None:
+                return {
+                    k: jax.make_array_from_process_local_data(
+                        self._sharding, v
+                    )
+                    for k, v in host_batch.items()
+                }
+            return {k: jax.device_put(v) for k, v in host_batch.items()}
 
     def __iter__(self) -> Iterator[Dict[str, Any]]:
         return self.iter_from(0)

@@ -7,6 +7,15 @@ XLA/TensorBoard trace (HLO timelines, per-op device time) via
 ``jax.profiler`` — the tool that actually explains TPU step time.
 
 Host 0 profiles; other processes no-op (one trace per job).
+
+The profiler is also a graft-scope consumer: telemetry health triggers
+(nonfinite grads, cross-host step-time skew) can :meth:`~StepProfiler.arm`
+a fresh window mid-run, so the trace that explains an anomaly is captured
+in the SAME run that detected it. On resume, the Trainer calls
+:meth:`~StepProfiler.rebase` so the configured window is interpreted
+relative to the resumed step — a window of (10, 13) traces the 10th-12th
+steps of THIS run, not of the whole job history (a resume landing past an
+absolute window would otherwise never capture).
 """
 
 from __future__ import annotations
@@ -36,8 +45,48 @@ class StepProfiler:
         self.logdir = logdir if process_index == 0 else None
         self.start_step, self.stop_step = window
         self._active = False
+        self._last_step = -1
+        self._arm_reason = ""
+
+    def rebase(self, first_step: int) -> None:
+        """Re-anchor the configured window at ``first_step`` (resume).
+
+        The window is run-relative: resuming at step 500 with window
+        (10, 13) traces steps [510, 513). No-op for fresh runs
+        (``first_step == 0``) and once stepping has begun.
+        """
+        if self.logdir is None or self._active or self._last_step >= 0:
+            return
+        if first_step:
+            self.start_step += first_step
+            self.stop_step += first_step
+            logger.info(
+                "Profiler window rebased to [%d, %d) from resumed step %d",
+                self.start_step, self.stop_step, first_step,
+            )
+
+    def arm(self, start_step: int, stop_step: int, reason: str = "") -> bool:
+        """Arm a fresh trace window (graft-scope trigger path).
+
+        Refused while a trace is active or a not-yet-passed window is still
+        pending — one window at a time, first trigger wins.
+        """
+        if self.logdir is None or self._active:
+            return False
+        if self._last_step < self.stop_step:
+            return False  # the configured window is still ahead or open
+        if stop_step <= start_step or start_step <= self._last_step:
+            return False
+        self.start_step, self.stop_step = start_step, stop_step
+        self._arm_reason = reason
+        logger.info(
+            "Profiler armed for steps [%d, %d)%s",
+            start_step, stop_step, f": {reason}" if reason else "",
+        )
+        return True
 
     def step(self, global_step: int) -> None:
+        self._last_step = global_step
         if self.logdir is None:
             return
         if not self._active and self.start_step <= global_step < self.stop_step:
@@ -54,10 +103,33 @@ class StepProfiler:
     def _stop(self) -> None:
         import jax
 
-        jax.profiler.stop_trace()
-        self._active = False
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            # a capture that failed to open must not take the run down at
+            # teardown; the window state is reset either way
+            logger.warning("Profiler stop_trace failed", exc_info=True)
+        finally:
+            self._active = False
         logger.info("Profiler trace written to %s", self.logdir)
 
     def close(self) -> None:
+        """Stop an open capture; report a window that never opened.
+
+        Safe to call repeatedly, and clean for an armed-but-unopened window
+        (run ended before ``start_step``): nothing to flush, but the miss is
+        logged so a silent "no trace produced" has a visible cause.
+        """
         if self._active:
             self._stop()
+        elif (
+            self.logdir is not None
+            and self._last_step >= 0
+            and self._last_step < self.start_step
+        ):
+            logger.info(
+                "Profiler window [%d, %d) never opened (run ended at step "
+                "%d)%s",
+                self.start_step, self.stop_step, self._last_step,
+                f"; armed: {self._arm_reason}" if self._arm_reason else "",
+            )

@@ -1,0 +1,47 @@
+"""graft-scope: always-on, low-overhead training telemetry.
+
+The reference's observability is print-lines and wall-clock epoch timing
+(reference train.py:265,283-290; SURVEY.md §5 "Tracing/profiling: ABSENT").
+graft-scope rebuilds that surface TPU-first around four pillars:
+
+- **compile-time cost registry** (:mod:`~.cost`): every train/eval-step
+  compile records XLA's ``cost_analysis()`` / ``memory_analysis()`` plus the
+  compiled collective mix, so analytical MFU and HBM headroom are per-run
+  telemetry instead of offline analysis;
+- **device-side health sentinels** (:mod:`~.sentinels`): global grad-norm,
+  param-norm and nonfinite-grad count computed INSIDE the jitted step and
+  fetched once per log boundary — no added per-step host syncs (the
+  ``host-sync`` graft-lint rule stays clean over the instrumented step);
+- **step-time + straggler telemetry** (:mod:`~.steptime`): a rate-limited
+  host clock (true fence every K steps, async otherwise) with per-host step
+  times exchanged via ``process_allgather`` at log boundaries, emitting
+  max/median skew and flagging slow hosts (gracefully absent at world
+  size 1);
+- **span tracing** (:mod:`~.trace`): ``telemetry.span("data_load")`` etc.
+  streamed as Chrome trace-event JSON (load in Perfetto / chrome://tracing)
+  next to ``metrics.jsonl``.
+
+:class:`~.scope.Telemetry` is the facade the Trainer drives; everything here
+degrades to a no-op when unconfigured.
+"""
+
+from distributed_pytorch_example_tpu.telemetry.cost import (  # noqa: F401
+    CostRegistry,
+    compiled_cost_record,
+    peak_bf16_flops,
+)
+from distributed_pytorch_example_tpu.telemetry.scope import (  # noqa: F401
+    Telemetry,
+    TelemetryConfig,
+)
+from distributed_pytorch_example_tpu.telemetry.sentinels import (  # noqa: F401
+    SENTINEL_KEYS,
+    sentinel_metrics,
+)
+from distributed_pytorch_example_tpu.telemetry.steptime import (  # noqa: F401
+    StepClock,
+    exchange_step_times,
+)
+from distributed_pytorch_example_tpu.telemetry.trace import (  # noqa: F401
+    TraceWriter,
+)

@@ -1,0 +1,142 @@
+"""Compile-time cost registry: XLA cost/memory analysis as run telemetry.
+
+Every train/eval-step compile records what the compiler itself knows about
+the program — per-device FLOPs, bytes accessed, argument/output/temp sizes
+(an HBM-residency estimate), and the collective mix parsed from the
+compiled HLO (``analysis/collectives.py``). Analytical MFU and HBM headroom
+then come for free with each measured step time, instead of the offline
+one-off analysis the r3/r5 perf rounds had to reconstruct by hand.
+
+Everything is best-effort: backends that cannot answer an analysis query
+(or an aborted AOT compile) degrade to ``None`` fields, never an error in
+the training path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# peak dense bf16 FLOP/s per chip by PJRT device_kind substring (the table
+# bench.py judges MFU against; CPU and unknown kinds return None)
+PEAK_BF16 = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+}
+
+
+def peak_bf16_flops(device) -> Optional[float]:
+    """Peak dense bf16 FLOP/s for one chip, or None when unknown (CPU)."""
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in PEAK_BF16.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def _cost_analysis(compiled) -> Dict[str, float]:
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0]
+        return dict(analysis)
+    except Exception:
+        return {}
+
+
+def _memory_analysis(compiled) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    try:
+        stats = compiled.memory_analysis()
+    except Exception:
+        return out
+    for attr, key in (
+        ("argument_size_in_bytes", "argument_bytes"),
+        ("output_size_in_bytes", "output_bytes"),
+        ("temp_size_in_bytes", "temp_bytes"),
+        ("alias_size_in_bytes", "alias_bytes"),
+        ("generated_code_size_in_bytes", "code_bytes"),
+    ):
+        val = getattr(stats, attr, None)
+        if val is not None:
+            out[key] = int(val)
+    return out
+
+
+def compiled_cost_record(compiled, device=None) -> Dict[str, object]:
+    """One compile's cost/memory/collective record (all fields best-effort).
+
+    ``hbm_peak_bytes`` is the residency estimate args + outputs + temps −
+    aliased (donated buffers counted once) — the same accounting
+    ``scripts/pipeline_memory.py`` reads off ``memory_analysis()``.
+    """
+    cost = _cost_analysis(compiled)
+    mem = _memory_analysis(compiled)
+    flops = cost.get("flops")
+    record: Dict[str, object] = {
+        "flops_per_step_per_device": float(flops) if flops else None,
+        "bytes_accessed": (
+            float(cost["bytes accessed"])
+            if "bytes accessed" in cost else None
+        ),
+        **mem,
+    }
+    if {"argument_bytes", "output_bytes", "temp_bytes"} <= mem.keys():
+        record["hbm_peak_bytes"] = (
+            mem["argument_bytes"] + mem["output_bytes"] + mem["temp_bytes"]
+            - mem.get("alias_bytes", 0)
+        )
+    else:
+        record["hbm_peak_bytes"] = None
+    try:
+        from distributed_pytorch_example_tpu.analysis.collectives import (
+            parse_collectives,
+        )
+
+        record["collectives"] = parse_collectives(compiled.as_text())
+    except Exception:
+        record["collectives"] = None
+    if device is not None:
+        record["device_kind"] = getattr(device, "device_kind", None)
+        record["peak_bf16_flops"] = peak_bf16_flops(device)
+    return record
+
+
+class CostRegistry:
+    """Per-run registry of compile cost records, keyed by tag.
+
+    Tags are the Trainer's program names ("train_step", "eval_step"); a tag
+    recompiled for a new batch shape overwrites its record (the latest
+    program is the one the loop is driving).
+    """
+
+    def __init__(self):
+        self.records: Dict[str, Dict[str, object]] = {}
+
+    def record(self, tag: str, compiled, device=None,
+               extra: Optional[Dict[str, object]] = None):
+        rec = compiled_cost_record(compiled, device)
+        rec["tag"] = tag
+        if extra:
+            rec.update(extra)
+        self.records[tag] = rec
+        return rec
+
+    def get(self, tag: str) -> Optional[Dict[str, object]]:
+        return self.records.get(tag)
+
+    def mfu_analytic(
+        self, tag: str, step_time_ms: Optional[float]
+    ) -> Optional[float]:
+        """flops / (step_time * peak bf16); None when either is unknown."""
+        rec = self.records.get(tag)
+        if not rec or not step_time_ms:
+            return None
+        flops = rec.get("flops_per_step_per_device")
+        peak = rec.get("peak_bf16_flops")
+        if not flops or not peak:
+            return None
+        return float(flops) / (step_time_ms / 1000.0) / float(peak)

@@ -1,0 +1,60 @@
+"""Device-side health sentinels, computed INSIDE the jitted train step.
+
+The reference reads training health off a per-step host sync
+(``loss.item()``, reference train.py:141). Here the health scalars — global
+gradient norm, parameter norm, nonfinite-gradient element count — are part
+of the compiled step's metrics dict: a handful of reductions fused into the
+step program, fetched together with the loss at a log boundary. No extra
+host round-trips, no ``jax.debug`` callbacks (the ``debug-callback``
+graft-lint rule forbids those in the step).
+
+Under sharded configs (FSDP / ZeRO-1 / pipeline) the leaves these norms
+reduce over are sharded arrays; the partial-sum all-reduce GSPMD inserts is
+part of the committed comm budget (``analysis/comm_budgets.json``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+# the keys sentinel_metrics adds to the step's metrics dict
+SENTINEL_KEYS = ("grad_norm", "param_norm", "nonfinite_grads")
+
+
+def global_norm(tree: Any) -> jax.Array:
+    """sqrt(sum of squared elements) over every leaf, accumulated in f32."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    # bf16 params upcast per-leaf before squaring (f32 island — allowlisted
+    # for the bf16-upcast jaxpr lint under telemetry/sentinels.py)
+    total = sum(
+        jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves
+    )
+    return jnp.sqrt(total)
+
+
+def nonfinite_count(tree: Any) -> jax.Array:
+    """Number of NaN/Inf elements across every leaf, as an f32 scalar."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    total = sum(jnp.sum(~jnp.isfinite(x)) for x in leaves)
+    return total.astype(jnp.float32)
+
+
+def sentinel_metrics(grads: Any, params: Any) -> Dict[str, jax.Array]:
+    """The sentinel struct the train step merges into its metrics.
+
+    All values are f32 device scalars — async until a log-boundary fetch,
+    accumulator-friendly (``train/metrics.py``), and identical on every
+    process (the reductions are global by construction).
+    """
+    return {
+        "grad_norm": global_norm(grads),
+        "param_norm": global_norm(params),
+        "nonfinite_grads": nonfinite_count(grads),
+    }

@@ -1,0 +1,98 @@
+"""Chrome trace-event span writer (Perfetto / chrome://tracing loadable).
+
+Streams complete ("ph": "X") events as a JSON array next to
+``metrics.jsonl``: one event per ``span(...)`` context, timestamped in
+microseconds off the monotonic clock, ``pid`` = JAX process index, ``tid`` =
+a small stable id per host thread (the loader's prefetch thread shows up as
+its own track). Buffered writes, thread-safe, and drop-on-closed so late
+spans from a background producer thread never crash teardown.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+class TraceWriter:
+    """Buffered trace-event sink; no-op when ``path`` is None."""
+
+    def __init__(
+        self,
+        path: Optional[str],
+        process_index: int = 0,
+        flush_every: int = 256,
+    ):
+        self.path = path
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._flush_every = flush_every
+        self._fh = None
+        self._wrote_any = False
+        self._tids: dict = {}
+        self._pid = process_index
+        if path:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self._fh = open(path, "w")
+            self._fh.write("[\n")
+            self._events.append({
+                "ph": "M", "name": "process_name", "pid": self._pid,
+                "tid": 0, "args": {"name": f"host{self._pid}"},
+            })
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            tid = len(self._tids)
+            self._tids[ident] = tid
+        return tid
+
+    def add_complete(self, name: str, ts_us: int, dur_us: int) -> None:
+        """Record one complete event (call under no lock; takes its own)."""
+        with self._lock:
+            if self._fh is None and self.path:
+                return  # closed: late spans from the prefetch thread drop
+            if self._fh is None:
+                return
+            self._events.append({
+                "name": name, "ph": "X", "ts": ts_us, "dur": max(dur_us, 1),
+                "pid": self._pid, "tid": self._tid(),
+            })
+            if len(self._events) >= self._flush_every:
+                self._flush_locked()
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        t0 = _now_us()
+        try:
+            yield
+        finally:
+            self.add_complete(name, t0, _now_us() - t0)
+
+    def _flush_locked(self) -> None:
+        if self._fh is None or not self._events:
+            return
+        chunk = ",\n".join(json.dumps(e) for e in self._events)
+        self._fh.write((",\n" if self._wrote_any else "") + chunk)
+        self._wrote_any = True
+        self._events.clear()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is None:
+                return
+            self._flush_locked()
+            self._fh.write("\n]\n")
+            self._fh.close()
+            self._fh = None

@@ -18,12 +18,13 @@ Behavioral parity with the reference's ``main()`` orchestration
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import os
 import signal
 import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -40,8 +41,31 @@ from distributed_pytorch_example_tpu.train.step import (
     build_train_step,
     init_state,
 )
+from distributed_pytorch_example_tpu.telemetry import (
+    Telemetry,
+    TelemetryConfig,
+)
 
 logger = get_logger(__name__)
+
+
+def _span(scope: Optional[Telemetry], name: str):
+    """A graft-scope trace span, or a no-op when telemetry is off."""
+    if scope is None:
+        return contextlib.nullcontext()
+    return scope.span(name)
+
+
+def _spanned_batches(iterator, scope: Optional[Telemetry]):
+    """Wrap an iterator so each ``next()`` is timed as a "data_load" span
+    (the consumer-side wait on the loader's prefetch queue)."""
+    while True:
+        with _span(scope, "data_load"):
+            try:
+                item = next(iterator)
+            except StopIteration:
+                return
+        yield item
 
 
 class PreemptionInterrupt(BaseException):
@@ -72,6 +96,8 @@ class Trainer:
         checkpoint_format: str = "auto",
         save_every_steps: int = 0,
         grad_accum_steps: int = 1,
+        telemetry: Union[bool, TelemetryConfig] = True,
+        telemetry_every: int = 0,
     ):
         self.model = model
         self.task = task
@@ -110,6 +136,21 @@ class Trainer:
                 f"{checkpoint_format!r}"
             )
         self._checkpoint_format = checkpoint_format
+        # graft-scope (telemetry/): cost registry at compile, device-side
+        # health sentinels fetched at log boundaries, rate-limited step
+        # clock + cross-host straggler exchange, Chrome-trace spans. True
+        # uses the defaults (epoch records only); telemetry_every>0 adds a
+        # metrics.jsonl record every N steps; a TelemetryConfig wins over
+        # both; False disables the scope entirely.
+        if isinstance(telemetry, TelemetryConfig):
+            self._telemetry_cfg: Optional[TelemetryConfig] = telemetry
+        elif telemetry:
+            self._telemetry_cfg = TelemetryConfig(every=telemetry_every)
+        else:
+            self._telemetry_cfg = None
+        self.scope: Optional[Telemetry] = None
+        self.telemetry_summary: Dict[str, Any] = {}
+        self._compiled: Dict[Any, Any] = {}  # AOT executables by shape key
         # >0: write `latest` every N train batches WITH the loader cursor
         # (epoch, batch_in_epoch) so resume restarts at the exact batch —
         # step-level resume on top of the reference's epoch granularity
@@ -156,6 +197,99 @@ class Trainer:
         inputs_key = self.task.batch_keys[0]
         return batch[inputs_key]
 
+    # -- AOT step executables (graft-scope cost registry) -----------------
+
+    @staticmethod
+    def _shape_key(tag: str, batch) -> tuple:
+        return (tag, tuple(sorted(
+            (k, tuple(v.shape), str(v.dtype)) for k, v in batch.items()
+        )))
+
+    def _train_executable(self, batch):
+        """AOT-compile the train step ONCE per batch shape and register its
+        cost/memory/collective record with graft-scope. The compiled
+        program is the same one ``jax.jit`` would cache — AOT just exposes
+        ``cost_analysis()``/``memory_analysis()`` at the moment the compile
+        happens. Falls back to the plain jit callable if AOT lowering is
+        unavailable for a config (telemetry then has no cost record)."""
+        if self.scope is None:
+            return None, self.train_step
+        key = self._shape_key("train", batch)
+        exe = self._compiled.get(key)
+        if exe is None:
+            try:
+                exe = self.train_step.lower(self.state, batch).compile()
+                self.scope.record_compile("train_step", exe)
+            except Exception:
+                logger.warning(
+                    "graft-scope: AOT compile of the train step failed; "
+                    "running the plain jit path (no cost record)",
+                    exc_info=True,
+                )
+                exe = self.train_step
+            self._compiled[key] = exe
+        elif (
+            exe is not self.train_step
+            and self.scope.costs.get("train_step") is None
+        ):
+            # a later fit() reuses the cached executable: re-register its
+            # cost record with the new run's scope (analysis is cheap)
+            self.scope.record_compile("train_step", exe)
+        return key, exe
+
+    def _eval_executable(self, batch):
+        if self.scope is None:
+            return None, self.eval_step
+        key = self._shape_key("eval", batch)
+        exe = self._compiled.get(key)
+        if exe is None:
+            try:
+                exe = self.eval_step.lower(
+                    self.state, batch, jnp.asarray(0, jnp.int32)
+                ).compile()
+                self.scope.record_compile("eval_step", exe)
+            except Exception:
+                logger.warning(
+                    "graft-scope: AOT compile of the eval step failed; "
+                    "running the plain jit path (no cost record)",
+                    exc_info=True,
+                )
+                exe = self.eval_step
+            self._compiled[key] = exe
+        elif (
+            exe is not self.eval_step
+            and self.scope.costs.get("eval_step") is None
+        ):
+            self.scope.record_compile("eval_step", exe)
+        return key, exe
+
+    def _dispatch(self, key, exe, jit_fn, *args):
+        """Call an AOT step executable, recovering from sharding drift.
+
+        A partitioner that re-lays-out the state inside the step (expert
+        parallelism re-sharding a freshly initialised replicated router,
+        say) leaves post-step-1 state with shardings that differ from what
+        step 1's AOT executable was compiled against. ``jax.jit`` would
+        transparently compile a second specialisation; an AOT executable
+        raises instead. The mismatch is detected during argument
+        validation — before any buffer is donated — so the state is intact
+        and the plain jit path can take over dispatch for this shape (the
+        cost record from the original compile is already registered).
+        """
+        if exe is jit_fn:
+            return exe(*args)
+        try:
+            return exe(*args)
+        except ValueError as err:
+            if "sharding(s)" not in str(err):
+                raise
+            logger.info(
+                "graft-scope: input shardings drifted from the AOT "
+                "compile; handing this step shape back to jax.jit"
+            )
+            self._compiled[key] = jit_fn
+            return jit_fn(*args)
+
     # -- epochs -----------------------------------------------------------
 
     def train_epoch(
@@ -176,13 +310,30 @@ class Trainer:
             it = loader.iter_from(start_batch)
         else:
             it = iter(loader)
-        for batch_idx, batch in enumerate(it, start=start_batch):
+        scope = self.scope
+        for batch_idx, batch in enumerate(
+            _spanned_batches(iter(it), scope), start=start_batch
+        ):
             if self._profiler is not None:
                 self._profiler.step(self._global_step)
             with self._mesh_ctx():
-                self.state, metrics = self.train_step(self.state, batch)
+                step_key, step_fn = self._train_executable(batch)
+                with _span(scope, "step"):
+                    self.state, metrics = self._dispatch(
+                        step_key, step_fn, self.train_step,
+                        self.state, batch,
+                    )
             self._global_step += 1
             acc.append(metrics)
+            if scope is not None:
+                # rate-limited clock tick + (at boundaries) the one-fetch
+                # health check, straggler exchange, and per-N-step record.
+                # The fence fetches a live VALUE — the only reliable
+                # dispatch fence over the tunneled remote-TPU platform.
+                scope.on_step(
+                    self._global_step, metrics,
+                    fence=lambda m=metrics: float(m["loss"]),
+                )
             if batch_idx % self.log_every == 0 and dist.is_coordinator():
                 logger.info(
                     "Epoch %d, Batch %d/%d, Loss: %.4f",
@@ -233,18 +384,19 @@ class Trainer:
     def _save_mid_epoch(self, epoch, batch_idx, metrics):
         """Write `latest` stamped with the CURRENT epoch + loader cursor
         (end-of-epoch saves stamp epoch+1 with no cursor)."""
-        ckpt_lib.save_checkpoint(
-            os.path.join(self.checkpoint_dir, ckpt_lib.LATEST_NAME),
-            self.state,
-            epoch,
-            float(metrics["loss"]),
-            {
-                "best_accuracy": self._best_accuracy,
-                "batch_in_epoch": batch_idx + 1,
-            },
-            saver=self._saver,
-            sharded=self._sharded_ckpt(),
-        )
+        with _span(self.scope, "checkpoint"):
+            ckpt_lib.save_checkpoint(
+                os.path.join(self.checkpoint_dir, ckpt_lib.LATEST_NAME),
+                self.state,
+                epoch,
+                float(metrics["loss"]),
+                {
+                    "best_accuracy": self._best_accuracy,
+                    "batch_in_epoch": batch_idx + 1,
+                },
+                saver=self._saver,
+                sharded=self._sharded_ckpt(),
+            )
 
     def validate(self, loader) -> Dict[str, float]:
         acc = MetricAccumulator()
@@ -252,11 +404,15 @@ class Trainer:
             with self._mesh_ctx():
                 # device scalar index: one trace for all batches, distinct
                 # eval rng per batch (MLM masks must not repeat across val)
-                acc.append(
-                    self.eval_step(
-                        self.state, batch, jnp.asarray(batch_idx, jnp.int32)
+                eval_key, eval_fn = self._eval_executable(batch)
+                with _span(self.scope, "eval"):
+                    acc.append(
+                        self._dispatch(
+                            eval_key, eval_fn, self.eval_step,
+                            self.state, batch,
+                            jnp.asarray(batch_idx, jnp.int32),
+                        )
                     )
-                )
         return acc.result()
 
     # -- full fit ---------------------------------------------------------
@@ -292,6 +448,26 @@ class Trainer:
             append=resuming,  # fresh runs truncate; resume continues the file
         )
 
+        if self._telemetry_cfg is not None:
+            cfg = self._telemetry_cfg
+            if cfg.trace_file is None and self._metrics_file:
+                # trace-event stream lands next to metrics.jsonl
+                cfg = dataclasses.replace(cfg, trace_file=os.path.join(
+                    os.path.dirname(self._metrics_file) or ".",
+                    "trace_events.json",
+                ))
+            self.scope = Telemetry(
+                cfg,
+                writer=writer,
+                profiler=self._profiler,
+                process_index=dist.process_index(),
+                fallback_every=self.log_every,
+            )
+            # h2d spans from the loaders' transfer path (prefetch thread)
+            for loader in (train_loader, val_loader):
+                if loader is not None and hasattr(loader, "telemetry"):
+                    loader.telemetry = self.scope
+
         start_epoch = 0
         start_batch = 0
         best_accuracy = 0.0
@@ -311,7 +487,13 @@ class Trainer:
         history: List[Dict[str, float]] = []
         start_time = time.time()
 
-        self._global_step = 0  # profile window is per-fit, not per-Trainer
+        # global step continues from the (possibly restored) state so
+        # telemetry records carry true step ids across resume; the profile
+        # window is run-relative — rebase re-anchors it at the resumed step
+        # (a resume landing past an absolute window would never capture)
+        self._global_step = int(jax.device_get(self.state.step))
+        if self._profiler is not None:
+            self._profiler.rebase(self._global_step)
         # graceful preemption: SIGTERM finishes the in-flight step, writes
         # `latest` with the loader cursor, and unwinds as
         # PreemptionInterrupt (the launcher's no-restart teardown rc is
@@ -338,6 +520,12 @@ class Trainer:
                 signal.signal(signal.SIGTERM, prev_term)
             # an exception mid-window must not leave a dangling active
             # jax trace, an unflushed metrics file, or a half-queued save
+            if self.scope is not None:
+                self.telemetry_summary = self.scope.close()
+                for loader in (train_loader, val_loader):
+                    if loader is not None and hasattr(loader, "telemetry"):
+                        loader.telemetry = None
+                self.scope = None
             if self._profiler is not None:
                 self._profiler.close()
             writer.close()
@@ -433,10 +621,24 @@ class Trainer:
                 self._best_accuracy = record["val_accuracy"]
             if self.checkpoint_dir:
                 extra = {"best_accuracy": self._best_accuracy}
-                # epoch+1 so resume continues AFTER the finished epoch
-                if is_best:
+                with _span(self.scope, "checkpoint"):
+                    # epoch+1 so resume continues AFTER the finished epoch
+                    if is_best:
+                        ckpt_lib.save_checkpoint(
+                            os.path.join(
+                                self.checkpoint_dir, ckpt_lib.BEST_NAME
+                            ),
+                            self.state,
+                            epoch + 1,
+                            record["train_loss"],
+                            extra,
+                            saver=self._saver,
+                            sharded=self._sharded_ckpt(),
+                        )
                     ckpt_lib.save_checkpoint(
-                        os.path.join(self.checkpoint_dir, ckpt_lib.BEST_NAME),
+                        os.path.join(
+                            self.checkpoint_dir, ckpt_lib.LATEST_NAME
+                        ),
                         self.state,
                         epoch + 1,
                         record["train_loss"],
@@ -444,14 +646,5 @@ class Trainer:
                         saver=self._saver,
                         sharded=self._sharded_ckpt(),
                     )
-                ckpt_lib.save_checkpoint(
-                    os.path.join(self.checkpoint_dir, ckpt_lib.LATEST_NAME),
-                    self.state,
-                    epoch + 1,
-                    record["train_loss"],
-                    extra,
-                    saver=self._saver,
-                    sharded=self._sharded_ckpt(),
-                )
             dist.barrier("epoch-end")
         return history, self._best_accuracy

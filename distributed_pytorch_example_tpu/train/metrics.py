@@ -10,9 +10,31 @@ scalar per metric key, regardless of epoch length.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, Iterable, Optional
 
 import jax
+
+
+def fetch_scalars(
+    metrics: Dict[str, jax.Array], keys: Optional[Iterable[str]] = None
+) -> Dict[str, float]:
+    """ONE host fetch of selected scalar metrics.
+
+    The graft-scope boundary fetch: loss + sentinel scalars come back in a
+    single ``device_get`` instead of one sync per key. Missing keys and
+    non-scalar values are skipped.
+    """
+    import numpy as np
+
+    wanted = set(keys) if keys is not None else None
+    selected = {
+        k: v for k, v in metrics.items()
+        if wanted is None or k in wanted
+    }
+    fetched = jax.device_get(selected)
+    return {
+        k: float(v) for k, v in fetched.items() if np.ndim(v) == 0
+    }
 
 
 class MetricAccumulator:

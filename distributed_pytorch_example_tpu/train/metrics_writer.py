@@ -33,13 +33,16 @@ class MetricsWriter:
     def write(self, record: Dict[str, Any]) -> None:
         if self._fh is None:
             return
-        # NaN/Inf are not valid JSON: drop absent metrics instead of
-        # emitting tokens strict parsers (jq, JSON.parse) reject
-        clean = {
-            k: v
-            for k, v in record.items()
-            if not (isinstance(v, float) and not math.isfinite(v))
-        }
+        # NaN/Inf are not valid JSON: drop the value instead of emitting
+        # tokens strict parsers (jq, JSON.parse) reject — but leave a
+        # `<key>_nonfinite: true` marker so a NaN loss is VISIBLE in the
+        # record rather than silently absent
+        clean: Dict[str, Any] = {}
+        for k, v in record.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                clean[f"{k}_nonfinite"] = True
+            else:
+                clean[k] = v
         self._fh.write(json.dumps(clean, sort_keys=True, allow_nan=False) + "\n")
 
     def close(self) -> None:

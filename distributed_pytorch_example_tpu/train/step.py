@@ -134,6 +134,7 @@ def build_train_step(
     optimizer: optax.GradientTransformation,
     partitioner: Optional[Partitioner] = None,
     grad_accum_steps: int = 1,
+    sentinels: bool = True,
 ):
     """One compiled optimization step: (state, batch) -> (state, metrics).
 
@@ -141,6 +142,14 @@ def build_train_step(
     the default replicated mode and ``grad_accum_steps=1`` the compiled
     program is byte-identical to the historical step. ``grad_accum_steps=N``
     scans N microbatches before ONE deferred gradient collective.
+
+    ``sentinels`` (default on) merges the graft-scope health scalars —
+    global grad-norm, param-norm, nonfinite-grad count
+    (``telemetry/sentinels.py``) — into the step's metrics dict. They are
+    computed inside the compiled program on the post-sync gradients and
+    updated params (a few fused reductions; under sharded configs their
+    partial-sum all-reduces are part of the committed comm budgets) and
+    fetched only at log boundaries, so health monitoring adds no host syncs.
     """
     if grad_accum_steps < 1:
         raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
@@ -326,6 +335,14 @@ def build_train_step(
             opt_state=new_opt_state,
             model_state=new_ms,
         )
+        if sentinels:
+            from distributed_pytorch_example_tpu.telemetry.sentinels import (
+                sentinel_metrics,
+            )
+
+            # post-sync grads + updated params: global values on every
+            # shard, async device scalars until a log-boundary fetch
+            metrics = {**metrics, **sentinel_metrics(grads, new_params)}
         return new_state, metrics
 
     return jax.jit(train_step, donate_argnums=0)

@@ -128,6 +128,15 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     parser.add_argument("--metrics-file", type=str, default=None,
                         help="JSONL epoch-metrics path (default: "
                         "<checkpoint-dir>/metrics.jsonl)")
+    parser.add_argument("--telemetry-every", type=int, default=0,
+                        help=">0: graft-scope writes a per-N-step record "
+                        "(step_time_ms, mfu_analytic, hbm_peak_bytes, "
+                        "grad_norm, skew) to the metrics JSONL and a Chrome "
+                        "trace-event file next to it; 0 keeps telemetry on "
+                        "(sentinels, straggler watch) but logs epochs only")
+    parser.add_argument("--no-telemetry", action="store_true",
+                        help="disable graft-scope entirely (no sentinels, "
+                        "no spans, no compiled-cost registry)")
     parser.add_argument("--save-every-steps", type=int, default=0,
                         help=">0: also write `latest` every N train batches "
                         "with the loader cursor, so --resume restarts at "

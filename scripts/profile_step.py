@@ -126,6 +126,17 @@ def main():
                   file=sys.stderr)
         if not comms:
             print("  (none — single-device program)", file=sys.stderr)
+        from distributed_pytorch_example_tpu.telemetry import (
+            compiled_cost_record,
+        )
+
+        cost = compiled_cost_record(compiled, jax.devices()[0])
+        print(
+            f"compiled cost: flops/device={cost['flops_per_step_per_device']}"
+            f" hbm_peak_bytes={cost['hbm_peak_bytes']}"
+            f" code_bytes={cost.get('code_bytes')}",
+            file=sys.stderr,
+        )
         state = trainer.state
         metrics = None
         for _ in range(3):

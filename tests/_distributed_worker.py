@@ -43,6 +43,8 @@ def main():
         32, mesh=mesh, shuffle=False,
     )
 
+    from distributed_pytorch_example_tpu.telemetry import TelemetryConfig
+
     trainer = dpx.train.Trainer(
         dpx.models.SimpleNet(),
         dpx.train.ClassificationTask(),
@@ -50,16 +52,22 @@ def main():
         partitioner=partitioner,
         checkpoint_dir=os.environ["DPX_TEST_CKPT_DIR"],
         log_every=1000,
+        # graft-scope straggler path: clock samples at steps 3/5/7, the
+        # boundary at steps 4/6/8 runs the cross-host step-time exchange
+        telemetry=TelemetryConfig(every=2, sample_every=2),
     )
     history = trainer.fit(loader, val, epochs=1)
 
     # every process must agree on the global metrics (computed inside jit on
     # the globally sharded batch)
+    summary = trainer.telemetry_summary
     print(json.dumps({
         "process": jax.process_index(),
         "n_devices": len(jax.devices()),
         "train_loss": history[-1]["train_loss"],
         "val_loss": history[-1]["val_loss"],
+        "straggler": summary.get("straggler", {}),
+        "grad_norm": summary.get("last_record", {}).get("grad_norm"),
     }))
     dpx.runtime.shutdown()
 

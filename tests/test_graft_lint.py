@@ -118,6 +118,59 @@ def test_mutable_default_fires_once_public_only():
     assert "public_api" in findings[0].message
 
 
+def test_debug_callback_fires_in_scope():
+    src = (
+        "import jax\n"
+        "def step(x):\n"
+        "    jax.debug.print('x={}', x)\n"
+        "    jax.debug.callback(lambda v: None, x)\n"
+        "    return x\n"
+    )
+    findings = pylint_rules.lint_source("ops/fused.py", src)
+    assert _rules(findings) == ["debug-callback", "debug-callback"]
+    assert "sentinel" in findings[0].message  # points at the graft-scope path
+
+
+def test_debug_callback_from_import_and_alias_forms():
+    src = (
+        "from jax import debug\n"
+        "import jax as j\n"
+        "def step(x):\n"
+        "    debug.callback(lambda v: None, x)\n"
+        "    j.debug.print('{}', x)\n"
+        "    return x\n"
+    )
+    findings = pylint_rules.lint_source("train/step.py", src)
+    assert _rules(findings) == ["debug-callback", "debug-callback"]
+
+
+def test_debug_callback_suppression_and_scope():
+    src = (
+        "import jax\n"
+        "def step(x):\n"
+        "    jax.debug.print('x={}', x)  # graft-lint: debug-callback\n"
+        "    return x\n"
+    )
+    assert pylint_rules.lint_source("ops/fused.py", src) == []
+    # outside the hot-path scope (loop.py, scripts) the rule stays quiet
+    src2 = "import jax\ndef f(x):\n    jax.debug.print('x', x)\n    return x\n"
+    assert pylint_rules.lint_source("train/loop.py", src2) == []
+    # plain print / unrelated .print attributes are not jax.debug
+    src3 = "def f(x, log):\n    print(x)\n    log.print(x)\n    return x\n"
+    assert pylint_rules.lint_source("ops/fused.py", src3) == []
+
+
+def test_real_instrumented_step_lints_clean():
+    # the acceptance gate: the sentinel-instrumented train step passes the
+    # full AST rule set (host-sync AND debug-callback) as committed
+    path = os.path.join(
+        REPO_ROOT, "distributed_pytorch_example_tpu", "train", "step.py"
+    )
+    with open(path) as fh:
+        src = fh.read()
+    assert pylint_rules.lint_source("train/step.py", src) == []
+
+
 def test_clean_package_zero_ast_findings():
     assert pylint_rules.lint_package() == []
 

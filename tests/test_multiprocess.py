@@ -64,6 +64,20 @@ def test_two_process_training(tmp_path):
     assert results[0]["val_loss"] == pytest.approx(results[1]["val_loss"])
     assert np.isfinite(results[0]["train_loss"])
 
+    # graft-scope straggler telemetry: each process saw BOTH hosts' step
+    # times via the boundary process_allgather, and derived the skew
+    for r in results:
+        straggler = r["straggler"]
+        times = straggler["step_time_ms_per_host"]
+        assert len(times) == 2 and all(t > 0 for t in times)
+        assert straggler["step_time_ms_max_host"] >= (
+            straggler["step_time_ms_median_host"]
+        )
+        assert straggler["step_time_skew"] >= 1.0
+        assert isinstance(straggler.get("slow_hosts", []), list)
+        assert r["grad_norm"] and np.isfinite(r["grad_norm"])
+    assert results[0]["straggler"] == results[1]["straggler"]
+
     # at process_count > 1 the Trainer auto-selects the SHARDED format
     # (collective-free, async-safe): the pointer file + per-process shard
     # files must restore in THIS (single-process, different-topology)

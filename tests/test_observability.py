@@ -70,3 +70,85 @@ def test_profiler_window_past_end_still_closes(devices, tmp_path):
     trainer.fit(tiny_loader(mesh), epochs=1)  # close() must stop the trace
     # a second fit must not crash on a dangling active trace
     trainer.fit(tiny_loader(mesh), epochs=1)
+
+
+def _trace_files(trace_dir):
+    return [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(trace_dir)
+        for f in fs
+    ]
+
+
+def test_profiler_rebase_shifts_window(devices, tmp_path):
+    from distributed_pytorch_example_tpu.runtime.profiler import StepProfiler
+
+    p = StepProfiler(str(tmp_path / "tr"), (2, 4))
+    p.rebase(100)  # resume at step 100: window becomes [102, 104)
+    assert (p.start_step, p.stop_step) == (102, 104)
+    for s in range(100, 108):
+        p.step(s)
+    p.close()
+    assert _trace_files(tmp_path / "tr"), "rebased window produced no trace"
+    # the passed window frees the arm slot; a pending one blocks reuse
+    assert not p.arm(50, 60)  # can't arm a window already in the past
+    assert p.arm(110, 112, reason="skew") is True
+    assert p.arm(120, 122) is False  # first trigger wins
+
+
+def test_profiler_rebase_noop_after_stepping(tmp_path):
+    from distributed_pytorch_example_tpu.runtime.profiler import StepProfiler
+
+    p = StepProfiler(str(tmp_path / "t4"), (2, 4))
+    p.step(0)
+    p.rebase(100)  # stepping already began: window must not move
+    assert (p.start_step, p.stop_step) == (2, 4)
+
+
+def test_profiler_armed_window_never_opens_closes_clean(tmp_path):
+    from distributed_pytorch_example_tpu.runtime.profiler import StepProfiler
+
+    p = StepProfiler(str(tmp_path / "t3"), (10, 12))
+    for s in range(4):
+        p.step(s)  # run ends before the window opens
+    p.close()  # must not raise, must not leave an active trace
+    assert not p._active
+    p.close()  # and stays idempotent
+
+
+def test_resume_rebases_profiler_window(devices, tmp_path):
+    trainer, mesh = tiny_trainer(tmp_path)
+    trainer.fit(tiny_loader(mesh), tiny_loader(mesh, 32), epochs=1)  # 4 steps
+    trace_dir = tmp_path / "resumed-trace"
+    trainer2, _ = tiny_trainer(
+        tmp_path, profile_dir=str(trace_dir), profile_window=(1, 3)
+    )
+    ckpt = tmp_path / "ckpt" / "latest_model.ckpt"
+    assert ckpt.exists()
+    # resumed global step is 4: without rebase the absolute window [1, 3)
+    # is already past and would never open; rebased it traces [5, 7)
+    trainer2.fit(
+        tiny_loader(mesh), tiny_loader(mesh, 32), epochs=2, resume=str(ckpt)
+    )
+    assert _trace_files(trace_dir), "resumed run captured no trace"
+
+
+def test_metrics_writer_marks_nonfinite(tmp_path):
+    from distributed_pytorch_example_tpu.train.metrics_writer import (
+        MetricsWriter,
+    )
+
+    path = tmp_path / "m.jsonl"
+    w = MetricsWriter(str(path))
+    w.write({"epoch": 0, "val_loss": float("nan"), "train_loss": 1.5})
+    w.write({"epoch": 1, "val_loss": 0.25, "grad_norm": float("inf")})
+    w.close()
+    # every line must stay strict-JSON (json.loads == the jq/JSON.parse bar)
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    # dropped value leaves a visible marker, finite neighbors untouched
+    assert "val_loss" not in recs[0]
+    assert recs[0]["val_loss_nonfinite"] is True
+    assert recs[0]["train_loss"] == 1.5
+    assert recs[1]["val_loss"] == 0.25
+    assert "grad_norm" not in recs[1]
+    assert recs[1]["grad_norm_nonfinite"] is True

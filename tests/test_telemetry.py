@@ -1,0 +1,419 @@
+"""graft-scope telemetry: sentinels, cost registry, step clock, traces.
+
+Tier-1 coverage of the four pillars (telemetry/__init__.py) plus the
+acceptance gates: per-step metrics records + a valid Chrome trace-event
+file from one instrumented fit, the nonfinite sentinel firing on an
+injected NaN batch, instrumentation overhead <= 2% over the SAME compiled
+executable, and the profiler auto-arm trigger path.
+"""
+
+import json
+import threading
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import distributed_pytorch_example_tpu as dpx
+from distributed_pytorch_example_tpu.telemetry import (
+    CostRegistry,
+    SENTINEL_KEYS,
+    StepClock,
+    Telemetry,
+    TelemetryConfig,
+    TraceWriter,
+    compiled_cost_record,
+    exchange_step_times,
+    peak_bf16_flops,
+)
+
+
+def tiny_trainer(tmp_path, **kw):
+    mesh = dpx.runtime.make_mesh()
+    return dpx.train.Trainer(
+        dpx.models.SimpleNet(hidden_size=32),
+        dpx.train.ClassificationTask(),
+        optax.adam(1e-3),
+        partitioner=dpx.parallel.data_parallel(mesh),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        **kw,
+    ), mesh
+
+
+def tiny_loader(mesh, n=64):
+    ds = dpx.data.SyntheticClassificationDataset(num_samples=n, input_size=784)
+    return dpx.data.DeviceLoader(ds, 16, mesh=mesh, seed=0)
+
+
+def _sharded_batch(trainer, batch_np):
+    sharding = trainer.partitioner.batch_sharding()
+    return {
+        k: jax.make_array_from_process_local_data(sharding, v)
+        for k, v in batch_np.items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: one instrumented fit produces records, trace, and summary
+# ---------------------------------------------------------------------------
+
+
+def test_instrumented_fit_records_and_trace(devices, tmp_path):
+    trainer, mesh = tiny_trainer(
+        tmp_path, telemetry=TelemetryConfig(every=1, sample_every=2)
+    )
+    history = trainer.fit(tiny_loader(mesh), tiny_loader(mesh, 32), epochs=2)
+    assert len(history) == 2
+
+    records = [
+        json.loads(l)
+        for l in (tmp_path / "ckpt" / "metrics.jsonl").read_text().splitlines()
+    ]
+    # 2 epochs x 4 batches -> 8 per-step records alongside the epoch records
+    steps = [r for r in records if "step" in r and "event" not in r]
+    assert [r["step"] for r in steps] == list(range(1, 9))
+    for key in ("loss",) + tuple(SENTINEL_KEYS):
+        assert key in steps[0], key
+    assert steps[0]["nonfinite_grads"] == 0
+    assert steps[0]["grad_norm"] > 0
+    # compile-time cost registry rode along into the records
+    assert steps[0]["flops_per_step_per_device"] > 0
+    assert steps[0]["hbm_peak_bytes"] is None or steps[0]["hbm_peak_bytes"] > 0
+    # the clock's first true sample lands at step 3 (anchor at 1, window 2)
+    assert any(r["step_time_ms"] is not None for r in steps)
+    # world size 1: NO straggler fields, by contract
+    assert not any("step_time_ms_per_host" in r for r in records)
+    compiles = {r["tag"] for r in records if r.get("event") == "compile"}
+    assert compiles == {"train_step", "eval_step"}
+    epochs = [r for r in records if "epoch" in r]
+    assert len(epochs) == 2  # historical epoch records still written
+
+    # Chrome trace-event stream: valid JSON, every span kind present
+    trace = json.loads((tmp_path / "ckpt" / "trace_events.json").read_text())
+    names = {e["name"] for e in trace}
+    assert {"data_load", "h2d", "step", "eval", "checkpoint"} <= names
+    for e in trace:
+        if e["ph"] == "X":
+            assert e["dur"] >= 1 and e["ts"] >= 0
+            assert "pid" in e and "tid" in e
+
+    summary = trainer.telemetry_summary
+    assert summary["last_record"]["step"] == 8
+    assert summary["straggler"] == {}
+    assert summary["compiles"]["train_step"]["flops_per_step_per_device"] > 0
+    assert trainer.scope is None  # scope torn down with the fit
+
+
+def test_telemetry_off_means_no_scope(devices, tmp_path):
+    trainer, mesh = tiny_trainer(tmp_path, telemetry=False)
+    trainer.fit(tiny_loader(mesh), epochs=1)
+    assert trainer.telemetry_summary == {}
+    assert not (tmp_path / "ckpt" / "trace_events.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# sentinels: the nonfinite counter fires on a poisoned batch
+# ---------------------------------------------------------------------------
+
+
+def test_nonfinite_sentinel_fires_on_nan_batch(devices, tmp_path):
+    trainer, mesh = tiny_trainer(tmp_path)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 784)).astype(np.float32)
+    clean = {"x": x.copy(), "y": rng.integers(0, 10, (16,)).astype(np.int32)}
+    x[0, 0] = np.nan  # one poisoned sample NaN-s the loss, hence every grad
+    poisoned = {"x": x, "y": clean["y"].copy()}
+    # clean batch first (the step donates its input state): zero nonfinite
+    with mesh:
+        clean = _sharded_batch(trainer, clean)
+        trainer.init(clean["x"])
+        state, metrics = trainer.train_step(trainer.state, clean)
+        assert float(metrics["nonfinite_grads"]) == 0
+        assert float(metrics["grad_norm"]) > 0
+        assert float(metrics["param_norm"]) > 0
+        # then the poisoned batch trips the sentinel
+        poisoned = _sharded_batch(trainer, poisoned)
+        _, metrics = trainer.train_step(state, poisoned)
+        assert float(metrics["nonfinite_grads"]) > 0
+
+
+# ---------------------------------------------------------------------------
+# overhead: instrumented loop within 2% of the bare loop (same executable)
+# ---------------------------------------------------------------------------
+
+
+def test_overhead_within_two_percent(devices, tmp_path):
+    import gc
+    import time
+
+    mesh = dpx.runtime.make_mesh()
+    trainer = dpx.train.Trainer(
+        dpx.models.SimpleNet(hidden_size=512),
+        dpx.train.ClassificationTask(),
+        optax.adam(1e-3),
+        partitioner=dpx.parallel.data_parallel(mesh),
+        telemetry=False,
+    )
+    rng = np.random.default_rng(0)
+    batch = {
+        "x": rng.standard_normal((64, 784)).astype(np.float32),
+        "y": rng.integers(0, 10, (64,)).astype(np.int32),
+    }
+    n_steps, rounds = 15, 10
+    with mesh:
+        batch = _sharded_batch(trainer, batch)
+        trainer.init(batch["x"])
+        step = trainer.train_step.lower(trainer.state, batch).compile()
+        # the step donates its input state, so a single state threads
+        # through every loop via this holder (no reuse-after-donation)
+        holder = {"state": trainer.state}
+        metrics = None
+        for _ in range(5):  # warmup the executable + allocator
+            holder["state"], metrics = step(holder["state"], batch)
+        float(metrics["loss"])
+
+        def bare():
+            # the UNinstrumented Trainer loop: the log boundary already
+            # fetches that step's loss every log_every steps
+            # (train/loop.py); graft-scope's budget is measured on top of
+            # that pre-existing cadence, not an idealized fence-free loop
+            metrics = None
+            t0 = time.perf_counter()
+            for s in range(1, n_steps + 1):
+                holder["state"], metrics = step(holder["state"], batch)
+                if s % 10 == 0:
+                    float(metrics["loss"])
+            float(metrics["loss"])
+            return time.perf_counter() - t0
+
+        def instrumented(i):
+            scope = Telemetry(
+                TelemetryConfig(
+                    every=0,
+                    sample_every=8,
+                    trace_file=str(tmp_path / f"trace_{i}.json"),
+                ),
+                fallback_every=10,
+            )
+            scope.record_compile("train_step", step)  # outside the timer
+            metrics = None
+            t0 = time.perf_counter()
+            for s in range(1, n_steps + 1):
+                with scope.span("step"):
+                    holder["state"], metrics = step(holder["state"], batch)
+                scope.on_step(
+                    s, metrics, fence=lambda m=metrics: float(m["loss"])
+                )
+            float(metrics["loss"])
+            dt = time.perf_counter() - t0
+            scope.close()
+            return dt
+
+        # interleaved rounds so machine drift hits both arms equally;
+        # min-of-N is the standard noise floor for microbenchmarks (per
+        # round this box jitters ~10%, far above the budget under test)
+        offs, ons = [], []
+        gc.disable()
+        try:
+            for i in range(rounds):
+                offs.append(bare())
+                ons.append(instrumented(i))
+        finally:
+            gc.enable()
+        t_off, t_on = min(offs), min(ons)
+
+    # <= 2% (+ a 15 ms absolute floor: at fake-mesh step times the 2%
+    # budget is tens of milliseconds, near host timer jitter)
+    assert t_on <= t_off * 1.02 + 0.015, (t_on, t_off, offs, ons)
+
+
+# ---------------------------------------------------------------------------
+# profiler auto-arm (graft-scope trigger -> StepProfiler.arm)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProfiler:
+    def __init__(self):
+        self.calls = []
+
+    def arm(self, start, stop, reason=""):
+        self.calls.append((start, stop, reason))
+        return True
+
+
+def test_auto_arm_on_nonfinite_grads():
+    prof = _FakeProfiler()
+    scope = Telemetry(TelemetryConfig(every=1), profiler=prof)
+    metrics = {
+        "loss": 1.0, "grad_norm": 3.0, "param_norm": 1.0,
+        "nonfinite_grads": 7.0,
+    }
+    scope.on_step(1, metrics, fence=lambda: None)
+    assert prof.calls == [(3, 5, "nonfinite grads (7 elements)")]
+    scope.close()
+
+
+def test_auto_arm_on_skew(monkeypatch):
+    from distributed_pytorch_example_tpu.telemetry import scope as scope_mod
+
+    straggler = {
+        "step_time_ms_per_host": [1.0, 2.6],
+        "step_time_skew": 2.6,
+        "slow_hosts": [1],
+    }
+    monkeypatch.setattr(
+        scope_mod, "exchange_step_times", lambda st, thr: dict(straggler)
+    )
+    prof = _FakeProfiler()
+    scope = Telemetry(TelemetryConfig(every=2), profiler=prof)
+    metrics = {
+        "loss": 1.0, "grad_norm": 3.0, "param_norm": 1.0,
+        "nonfinite_grads": 0.0,
+    }
+    scope.on_step(1, metrics, fence=lambda: None)  # not a boundary
+    assert prof.calls == []
+    scope.on_step(2, metrics, fence=lambda: None)
+    assert prof.calls == [(4, 6, "cross-host step-time skew 2.60x")]
+    assert scope.last_straggler == straggler
+    summary = scope.close()
+    assert summary["straggler"] == straggler
+
+
+def test_auto_arm_disabled():
+    prof = _FakeProfiler()
+    scope = Telemetry(
+        TelemetryConfig(every=1, auto_arm_profiler=False), profiler=prof
+    )
+    scope.on_step(
+        1,
+        {"loss": 1.0, "grad_norm": 1.0, "param_norm": 1.0,
+         "nonfinite_grads": 2.0},
+        fence=lambda: None,
+    )
+    assert prof.calls == []
+    scope.close()
+
+
+# ---------------------------------------------------------------------------
+# unit: cost registry / step clock / trace writer / straggler exchange
+# ---------------------------------------------------------------------------
+
+
+class _FakeMemStats:
+    argument_size_in_bytes = 100
+    output_size_in_bytes = 50
+    temp_size_in_bytes = 30
+    alias_size_in_bytes = 20
+    generated_code_size_in_bytes = 5
+
+
+class _FakeCompiled:
+    def cost_analysis(self):
+        return {"flops": 2.0e12, "bytes accessed": 1.0e9}
+
+    def memory_analysis(self):
+        return _FakeMemStats()
+
+    def as_text(self):
+        return "ENTRY main { ROOT t = f32[2] add(a, b) }"
+
+
+class _FakeDevice:
+    device_kind = "TPU v4"
+
+
+def test_cost_record_and_analytic_mfu():
+    rec = compiled_cost_record(_FakeCompiled(), _FakeDevice())
+    assert rec["flops_per_step_per_device"] == 2.0e12
+    assert rec["bytes_accessed"] == 1.0e9
+    assert rec["hbm_peak_bytes"] == 100 + 50 + 30 - 20
+    assert rec["code_bytes"] == 5
+    assert rec["collectives"] == {}
+    assert rec["peak_bf16_flops"] == 275e12
+
+    reg = CostRegistry()
+    reg.record("train_step", _FakeCompiled(), _FakeDevice())
+    # 2e12 flops / 10 ms / 275e12 peak
+    assert reg.mfu_analytic("train_step", 10.0) == pytest.approx(
+        2.0e12 / 0.01 / 275e12
+    )
+    assert reg.mfu_analytic("train_step", None) is None
+    assert reg.mfu_analytic("missing", 10.0) is None
+
+
+def test_cost_record_degrades_without_analysis():
+    class Opaque:
+        pass  # no cost_analysis / memory_analysis / as_text
+
+    rec = compiled_cost_record(Opaque())
+    assert rec["flops_per_step_per_device"] is None
+    assert rec["hbm_peak_bytes"] is None
+    assert rec["collectives"] is None
+
+
+def test_peak_flops_table():
+    class D:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    assert peak_bf16_flops(D("TPU v4")) == 275e12
+    assert peak_bf16_flops(D("TPU v5e")) == 197e12
+    assert peak_bf16_flops(D("TPU v5p")) == 459e12
+    assert peak_bf16_flops(D("cpu")) is None
+
+
+def test_step_clock_anchors_then_samples(monkeypatch):
+    from distributed_pytorch_example_tpu.telemetry import steptime
+
+    now = {"t": 100.0}
+    monkeypatch.setattr(steptime.time, "perf_counter", lambda: now["t"])
+    fences = []
+    clock = StepClock(sample_every=4)
+    clock.tick(1, lambda: fences.append(1))  # anchor only: no sample
+    assert clock.step_time_ms is None and fences == [1]
+    for s in (2, 3, 4):  # inside the window: NO fence, fully async
+        now["t"] += 0.010
+        clock.tick(s, lambda s=s: fences.append(s))
+    assert fences == [1] and clock.step_time_ms is None
+    now["t"] += 0.010
+    clock.tick(5, lambda: fences.append(5))  # window full: one true fence
+    assert fences == [1, 5]
+    assert clock.step_time_ms == pytest.approx(10.0)  # 40 ms over 4 steps
+
+
+def test_step_clock_rejects_bad_window():
+    with pytest.raises(ValueError):
+        StepClock(sample_every=0)
+
+
+def test_exchange_step_times_world_size_one():
+    # single-process contract: no skew fields, and no collective issued
+    assert exchange_step_times(12.5) == {}
+    assert exchange_step_times(None) == {}
+
+
+def test_trace_writer_valid_json_threads_and_close(tmp_path):
+    path = tmp_path / "trace.json"
+    tw = TraceWriter(str(path), process_index=0)
+    with tw.span("step"):
+        pass
+    t = threading.Thread(target=lambda: tw.add_complete("h2d", 10, 5))
+    t.start()
+    t.join()
+    tw.close()
+    events = json.loads(path.read_text())  # the array must parse as-is
+    names = {e["name"] for e in events}
+    assert {"process_name", "step", "h2d"} <= names
+    # the producer thread gets its own track
+    tids = {e["tid"] for e in events if e["ph"] == "X"}
+    assert len(tids) == 2
+    tw.add_complete("late", 1, 1)  # post-close span drops silently
+    tw.close()  # idempotent
+
+
+def test_trace_writer_disabled_is_noop():
+    tw = TraceWriter(None)
+    with tw.span("x"):
+        pass
+    tw.close()

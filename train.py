@@ -325,6 +325,8 @@ def main():
         profile_window=profile_window,
         checkpoint_format=args.checkpoint_format,
         save_every_steps=args.save_every_steps,
+        telemetry=not args.no_telemetry,
+        telemetry_every=args.telemetry_every,
     )
     try:
         trainer.fit(
