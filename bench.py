@@ -75,10 +75,27 @@ def run_model(name: str, args) -> dict:
         file=sys.stderr,
     )
 
-    mesh = dpx.runtime.make_mesh()
-    partitioner = dpx.parallel.data_parallel(
-        mesh, dp_shard_opt_state=args.zero1
-    )
+    pipelined = args.mesh_pipe > 1
+    if pipelined:
+        if not name.startswith(("gpt", "llama")):
+            raise ValueError(
+                f"--mesh-pipe applies to gpt2/llama only, not {name!r}"
+            )
+        mesh = dpx.runtime.make_mesh(
+            dpx.runtime.MeshSpec(
+                data=n_chips // args.mesh_pipe, pipe=args.mesh_pipe
+            )
+        )
+        from distributed_pytorch_example_tpu.parallel.partition import (
+            transformer_partitioner,
+        )
+
+        partitioner = transformer_partitioner(mesh)
+    else:
+        mesh = dpx.runtime.make_mesh()
+        partitioner = dpx.parallel.data_parallel(
+            mesh, dp_shard_opt_state=args.zero1
+        )
     global_batch = batch_per_chip * n_chips
     if batch_per_chip % args.grad_accum:
         raise ValueError(
@@ -96,6 +113,14 @@ def run_model(name: str, args) -> dict:
             overrides["remat"] = True
         if args.flash != "auto":
             overrides["use_flash"] = args.flash == "on"
+        if pipelined:
+            # pipeline-schedule ablation: gpipe vs 1f1b (recompute) vs
+            # 1f1b --pipe-no-recompute (stash) on the same mesh
+            overrides["pipe_axis"] = "pipe"
+            overrides["pipe_schedule"] = args.pipe_schedule
+            overrides["pipe_microbatches"] = args.pipe_microbatches
+            if args.pipe_no_recompute:
+                overrides["pipe_recompute"] = False
         model = dpx.models.get_model(name, **overrides)
         seq_len = min(args.seq_len, model.max_len)  # BERT caps at 512
         if seq_len != args.seq_len:
@@ -224,6 +249,15 @@ def run_model(name: str, args) -> dict:
                 if lm
                 else {"image_size": image_size}
             ),
+            **(
+                {
+                    "mesh_pipe": args.mesh_pipe,
+                    "pipe_schedule": args.pipe_schedule,
+                    "pipe_recompute": not args.pipe_no_recompute,
+                }
+                if pipelined
+                else {}
+            ),
         },
     }
     peak = cost.get("peak_bf16_flops")
@@ -283,11 +317,29 @@ def main():
                         choices=("fused", "dense"),
                         help="LM loss path: fused chunked-CE (default) or "
                         "dense materialized logits")
+    parser.add_argument("--mesh-pipe", type=int, default=1,
+                        help=">1: pipeline-parallel ablation over a "
+                        "data x pipe mesh (gpt2/llama; needs that many "
+                        "devices to divide the chip count)")
+    parser.add_argument("--pipe-schedule", default="1f1b",
+                        choices=("gpipe", "1f1b"),
+                        help="schedule for the --mesh-pipe ablation")
+    parser.add_argument("--pipe-microbatches", type=int, default=0,
+                        help="microbatches for the --mesh-pipe ablation "
+                        "(0 = auto)")
+    parser.add_argument("--pipe-no-recompute", action="store_true",
+                        help="1f1b activation-stash backward (no stage "
+                        "replay) for the --mesh-pipe ablation")
     args = parser.parse_args()
     if args.warmup < 1 or args.steps < 1:
         parser.error("--warmup and --steps must be >= 1")
     if args.grad_accum < 1:
         parser.error("--grad-accum must be >= 1")
+    if args.pipe_no_recompute and (
+        args.mesh_pipe <= 1 or args.pipe_schedule != "1f1b"
+    ):
+        parser.error("--pipe-no-recompute needs --mesh-pipe > 1 and "
+                     "--pipe-schedule 1f1b")
     names = [args.model] if args.model else args.models.split(",")
     for n in names:
         if n not in BASELINES:

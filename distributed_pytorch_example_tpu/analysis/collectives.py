@@ -98,6 +98,18 @@ def parse_collectives(hlo_text: str) -> Dict[str, Dict[str, int]]:
     return out
 
 
+# Schedule-implementation markers: jax.named_scope names that the 1F1B
+# backward modes stamp into op metadata (parallel/pipeline.py). They
+# survive into the compiled module's text, so the budget file can pin a
+# config to the backward mode it claims to exercise.
+SCHEDULE_MARKERS = ("1f1b_stash_apply", "1f1b_recompute_apply")
+
+
+def parse_markers(hlo_text: str) -> Dict[str, bool]:
+    """Presence of each ``SCHEDULE_MARKERS`` name in a compiled module."""
+    return {m: m in hlo_text for m in SCHEDULE_MARKERS}
+
+
 def compile_case(case) -> Tuple[object, object]:
     """(lowered, compiled) for a DryrunCase's train step — never executed.
 
@@ -125,6 +137,14 @@ def collective_record(case, compiled) -> Dict[str, object]:
         # structural contract, stronger than count/byte deltas: the gate
         # additionally requires RS+AG to be PRESENT (see compare_budgets)
         record["signature"] = "zero1-dp-step"
+    markers = parse_markers(text)
+    if "stash1f1b" in case.name.split("+"):
+        # pin the no-recompute config to its stash marker: a silent
+        # fallback to the replay backward stays under every byte budget
+        # (it REMOVES nothing) and only the signature can catch it
+        record["signature"] = "1f1b-stash"
+    if any(markers.values()):
+        record["markers"] = markers
     return record
 
 
@@ -134,6 +154,7 @@ def compare_budgets(
     byte_tolerance: float = DEFAULT_BYTE_TOLERANCE,
     config: Optional[str] = None,
     signature: Optional[str] = None,
+    markers: Optional[Dict[str, bool]] = None,
 ) -> Tuple[List[Finding], List[str]]:
     """(violations, notes) of a measured collective set vs its budget.
 
@@ -149,9 +170,43 @@ def compare_budgets(
     cannot catch the failure mode where the whole decomposition collapses
     back to all-reduce + full update (e.g. the optimizer state silently
     re-replicated) while staying under a stale budget.
+    ``"1f1b-stash"`` (the no-recompute 1F1B config): the compiled step's
+    op metadata must carry the ``1f1b_stash_apply`` named-scope marker
+    and must NOT carry ``1f1b_recompute_apply`` (``markers`` — see
+    ``parse_markers``). A silent fallback to the replay backward changes
+    no collective counts at all, so only this marker check can catch it.
     """
     violations: List[Finding] = []
     notes: List[str] = []
+    if signature == "1f1b-stash":
+        mk = markers or {}
+        if not mk.get("1f1b_stash_apply", False):
+            violations.append(Finding(
+                rule="comm-1f1b-stash-signature",
+                where="1f1b_stash_apply",
+                message=(
+                    "no-recompute 1F1B config compiled WITHOUT the "
+                    "stash-apply marker: the backward is not applying "
+                    "stashed vjp residuals (pipe_recompute=False lost on "
+                    "the way to one_f_one_b, or the named scope was "
+                    "renamed — keep parallel/pipeline.py and "
+                    "analysis/collectives.py SCHEDULE_MARKERS in sync)"
+                ),
+                config=config,
+            ))
+        if mk.get("1f1b_recompute_apply", False):
+            violations.append(Finding(
+                rule="comm-1f1b-stash-signature",
+                where="1f1b_recompute_apply",
+                message=(
+                    "no-recompute 1F1B config compiled WITH the replay "
+                    "backward marker: the schedule silently fell back to "
+                    "stage recompute (~4 forward-units per cycle instead "
+                    "of ~3) — no byte budget moves, only this signature "
+                    "catches it"
+                ),
+                config=config,
+            ))
     if signature == "zero1-dp-step":
         for kind in ("reduce-scatter", "all-gather"):
             if measured.get(kind, {}).get("count", 0) == 0:

@@ -157,6 +157,7 @@ def audit_configs(
                     signature=committed.get(
                         "signature", record.get("signature")
                     ),
+                    markers=record.get("markers"),
                 )
                 if skew is not None:
                     result.notes.extend(

@@ -46,6 +46,10 @@ class GPT2(nn.Module):
     # train calls must pass ``targets`` (the loss runs inside the
     # schedule); eval still uses the GPipe forward.
     pipe_schedule: str = "gpipe"
+    # 1f1b backward: True replays each stage from its stashed input
+    # (~4 forward-units/cycle); False applies vjp residuals stashed at
+    # forward time (~3 units, extra temp memory — parallel/pipeline.py)
+    pipe_recompute: bool = True
     decode: bool = False  # autoregressive KV-cache mode (train/generate.py)
     # "full": return (B, S, V) logits. "hidden": return the final hidden
     # states instead, for the fused chunked-CE loss (train/tasks.py pairs
@@ -151,6 +155,7 @@ class GPT2(nn.Module):
                 pipe_axis=self.pipe_axis,
                 pipe_microbatches=self.pipe_microbatches,
                 pipe_virtual=self.pipe_virtual,
+                pipe_recompute=self.pipe_recompute,
                 seq_axis=self.seq_axis,
                 sp_mode=self.sp_mode,
                 moe_experts=self.moe_experts,

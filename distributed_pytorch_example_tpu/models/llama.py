@@ -148,6 +148,8 @@ class Llama(nn.Module):
     pipe_virtual: int = 1  # interleaved 1F1B virtual chunks per stage
     # "gpipe" | "1f1b" — see models/gpt2.py pipe_schedule
     pipe_schedule: str = "gpipe"
+    # 1f1b backward mode — see models/gpt2.py pipe_recompute
+    pipe_recompute: bool = True
     moe_experts: int = 0  # >0: Mixtral-style MoE on every moe_every-th block
     moe_every: int = 2
     moe_top_k: int = 2  # Mixtral default: 2 experts per token
@@ -237,6 +239,7 @@ class Llama(nn.Module):
                 pipe_axis=self.pipe_axis,
                 pipe_microbatches=self.pipe_microbatches,
                 pipe_virtual=self.pipe_virtual,
+                pipe_recompute=self.pipe_recompute,
                 seq_axis=self.seq_axis,
                 sp_mode=self.sp_mode,
                 moe_experts=self.moe_experts,

@@ -385,6 +385,7 @@ def _run_stacked_1f1b(mod, params, x, last, block, moe: bool = False):
         last_fn=last_fn, last_params=last_params, last_args=last_args,
         pipe_axis=mod.pipe_axis, aux_weights=aux_weights,
         seq_axis=getattr(mod, "seq_axis", None), n_virtual=vchunks,
+        recompute=bool(getattr(mod, "pipe_recompute", True)),
     )
     return loss_sum, mets, aux, n_micro
 
@@ -541,6 +542,8 @@ class StackedDecoder(nn.Module):
     pipe_axis: Optional[str] = None  # mesh axis for pipeline stages
     pipe_microbatches: int = 0  # 0 = auto (largest k*pipe <= 4*pipe | batch)
     pipe_virtual: int = 1  # interleaved virtual chunks per stage (1f1b)
+    pipe_recompute: bool = True  # 1f1b backward: replay stage (True) or
+    # apply stashed vjp residuals (False — faster, more temp memory)
     seq_axis: Optional[str] = None  # SP inside the stages (SP x PP)
     sp_mode: str = "ring"  # "ring" | "ulysses"
     moe_experts: int = 0  # >0: MoE MLP on EVERY block (gelu experts)
@@ -719,6 +722,7 @@ class StackedLlamaDecoder(nn.Module):
     pipe_axis: Optional[str] = None
     pipe_microbatches: int = 0
     pipe_virtual: int = 1  # interleaved virtual chunks per stage (1f1b)
+    pipe_recompute: bool = True  # 1f1b backward: replay (True) | stash (False)
     seq_axis: Optional[str] = None  # SP inside the stages (SP x PP)
     sp_mode: str = "ulysses"  # "ring" | "ulysses" (llama family default)
     moe_experts: int = 0  # >0: Mixtral-style SwiGLU-expert MoE, EVERY block

@@ -338,25 +338,41 @@ def stack_stage_params(per_stage_params: Sequence[Any]) -> Any:
 # u_F == u_B: its F computes per-microbatch loss + dL/dy via
 # ``jax.value_and_grad`` over ``last_fn`` and its B consumes that seed in
 # the same cycle — this is what makes the schedule 1F1B rather than
-# all-F-then-all-B. Backwards run as per-microbatch ``jax.vjp`` with stage
-# RECOMPUTE from a stashed stage input (Megatron's selective recompute):
-# the only thing a stage keeps per in-flight microbatch is its INPUT, in a
-# ring of ``2(S-1)+1`` slots — peak stash is independent of n_micro, the
-# ~n_micro -> ~n_stages drop measured in scripts/pipeline_memory.py.
+# all-F-then-all-B. Backwards are per-microbatch ``jax.vjp``, in one of two
+# selectable modes (``recompute``):
+#
+# - ``recompute=True`` (Megatron's selective recompute): the only thing a
+#   stage keeps per in-flight microbatch is its INPUT, in a ring of
+#   ``2(S-1)+1`` slots, and B replays the stage forward to rebuild the vjp
+#   — cheapest memory, cycle cost ~4 forward-units.
+# - ``recompute=False`` (activation stash, production Megatron's default):
+#   F runs the stage UNDER ``jax.vjp`` and stashes the residual
+#   intermediates in per-leaf rings of the same ``2(S-1)+1`` depth; B
+#   restores the saved vjp and applies it — no replay, cycle cost ~3
+#   forward-units. Residual leaves that are verbatim stage params (the
+#   transpose's weight operands) are NOT ringed: params are constant
+#   within a step, so B substitutes the live leaves; the stage-input leaf
+#   rides the existing input ring. Peak stash stays independent of
+#   n_micro in both modes — the ~n_micro -> ~n_stages drop measured in
+#   scripts/pipeline_memory.py.
 #
 # Communication per cycle (all neighbor ICI): activations ppermute up,
 # cotangents ppermute down, the input queue rotates toward stage 0 (as in
 # GPipe), and finished dx microbatches ride a delivery ring up from stage 0
 # so dL/dx leaves sharded over pipe exactly like the input queue came in.
 #
-# Wall-clock honesty: a cycle costs one forward plus one
-# backward-with-recompute (~3 forward units), and there are
-# n_micro + 3(S-1) cycles, so at small n_micro this schedule is SLOWER than
-# GPipe-without-remat (which pays ~3 units x (n_micro + S - 1) ticks); it
-# matches GPipe-with-remat asymptotically and wins on what it is for:
-# activation memory, the binding constraint at depth x sequence scale.
-# Every stage also traces ``last_fn`` (SPMD — only the last stage's result
-# is kept), so keep the head cost in mind when S is large.
+# Wall-clock (measured frontier: results/pipeline_1f1b/ — temp MB and
+# stage-equivalent cycle cost for GPipe / 1F1B-recompute / 1F1B-stash at
+# m=32): a recompute cycle costs ~4 forward-units and a stash cycle ~3
+# over n_micro + 3(S-1) cycles, vs GPipe-without-remat's ~3 units x
+# (n_micro + S - 1) ticks. So 1F1B-stash matches no-remat GPipe's compute
+# asymptotically while keeping the n_micro-INDEPENDENT activation
+# footprint, and 1F1B-recompute trades ~33% more compute for the smallest
+# stash of all — pick by which side of the speed-memory frontier binds.
+# The head cost is predicated away: only the last stage evaluates
+# ``last_fn`` (``predicate_head``, a per-device ``lax.cond`` — legal
+# because ``last_fn`` is collective-free by contract; measured in
+# results/pipeline_1f1b/head_cost.json).
 #
 # Differentiation contract: ``one_f_one_b`` is wrapped in jax.custom_vjp
 # whose FORWARD pass runs the schedule and computes the parameter/input
@@ -440,7 +456,8 @@ def _zeros_of(struct):
 
 def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
                 stage_fn: StageFn, last_fn, axis_name: str, n_micro: int,
-                aux_desc, seq_axis=None, n_virtual: int = 1):
+                aux_desc, seq_axis=None, n_virtual: int = 1,
+                recompute: bool = True, predicate_head: bool = True):
     """Per-device 1F1B program; call under shard_map (manual on pipe).
 
     in_buf: (m_s, microbatch, ...) — this stage's shard of the input queue
@@ -556,9 +573,58 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
     _, mets_struct = jax.eval_shape(
         last_loss, y_proto, last_params, slice_args(jnp.int32(0))
     )
+    # full head output structure ((loss, metrics), (dy, dlast)) for the
+    # last-stage predication's skip branch
+    head_struct = jax.eval_shape(
+        lambda y_: jax.value_and_grad(
+            last_loss, argnums=(0, 1), has_aux=True
+        )(y_, last_params, slice_args(jnp.int32(0))),
+        y_proto,
+    )
+
+    def pv(x):
+        return pvary_like(x, in_buf, (axis_name,))
+
+    if recompute:
+        res_src = res_structs = None
+    else:
+        # Classify the stage vjp's residual leaves ONCE (abstract trace —
+        # nothing executes): a leaf that is literally a stage param (the
+        # transpose's weight operand) is restored at B time from the LIVE
+        # params (constant within a step); the stage-input leaf rides the
+        # existing input ring; every other leaf — the true forward
+        # intermediates — gets its own K-slot ring in the scan carry. The
+        # classification is trace-deterministic: same stage_fn + same
+        # avals => same residual list in the schedule's own trace below.
+        probe: dict = {}
+
+        def _probe(p, x_):
+            _, vjp_fn = jax.vjp(stage_fn, p, x_)
+            leaves, _ = jax.tree_util.tree_flatten(vjp_fn)
+            pids = {
+                id(l): i
+                for i, l in enumerate(jax.tree_util.tree_leaves(p))
+            }
+            probe["src"] = tuple(
+                ("param", pids[id(l)]) if id(l) in pids
+                else ("x", None) if l is x_
+                else ("ring", None)
+                for l in leaves
+            )
+            probe["structs"] = tuple(
+                jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves
+            )
+            return jnp.zeros(())
+
+        jax.eval_shape(_probe, pick(0), y_proto)
+        res_src = probe["src"]
+        res_structs = tuple(
+            s for s, (kind, _) in zip(probe["structs"], res_src)
+            if kind == "ring"
+        )
 
     def cycle(carry, t):
-        (incoming, cot_in, in_buf, stash, dx_buf, reg_dx, reg_du,
+        (incoming, cot_in, in_buf, stash, res_rings, dx_buf, reg_dx, reg_du,
          d_stage, d_last, loss_acc, mets_acc, aux_acc) = carry
 
         # ---- F sub-tick: invert t = w*V + r + j*S + stage ----
@@ -583,19 +649,58 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
         x_in = jnp.where(first_chunk_f, head, incoming)
         stash = _store(stash, x_in, jnp.mod(t, K), active_f)
         params_f = pick(j_f)
-        if aux_desc is None:
-            y = stage_fn(params_f, x_in)
+        aux_tick = vjp_treedef = None
+        if recompute:
+            if aux_desc is None:
+                y = stage_fn(params_f, x_in)
+            else:
+                y, aux_tick = stage_fn(params_f, x_in)
         else:
-            y, aux_tick = stage_fn(params_f, x_in)
+            # capture this forward's vjp; its residual intermediates ride
+            # per-leaf rings to the matching B sub-tick (no stage replay)
+            if aux_desc is None:
+                y, vjp_f = jax.vjp(stage_fn, params_f, x_in)
+            else:
+                (y, aux_tick), vjp_f = jax.vjp(stage_fn, params_f, x_in)
+            leaves_f, vjp_treedef = jax.tree_util.tree_flatten(vjp_f)
+            ringed_f = tuple(
+                l for l, (kind, _) in zip(leaves_f, res_src)
+                if kind == "ring"
+            )
+            res_rings = tuple(
+                _store(r, l, jnp.mod(t, K), active_f)
+                for r, l in zip(res_rings, ringed_f)
+            )
+        if aux_desc is not None:
             aux_acc = _tree_add(
                 aux_acc, _tree_where(active_f, aux_tick, aux_zero)
             )
 
-        # last chunk: per-microbatch loss, metrics, and the backward seed
-        (loss_u, mets_u), (dy_u, dlast_u) = jax.value_and_grad(
-            last_loss, argnums=(0, 1), has_aux=True
-        )(y, last_params, slice_args(u_f))
+        # last chunk: per-microbatch loss, metrics, and the backward seed.
+        # Only evaluated where the result is KEPT (``predicate_head``):
+        # ``last_fn`` is collective-free by contract, so the per-device
+        # ``lax.cond`` is legal SPMD and the other S-1 stages (and the
+        # fill/drain bubble cycles) skip the head's cost instead of
+        # computing a masked-out loss every cycle — measured in
+        # results/pipeline_1f1b/head_cost.json.
         keep = last_chunk_f & active_f
+
+        def _head_eval(y_):
+            return jax.value_and_grad(
+                last_loss, argnums=(0, 1), has_aux=True
+            )(y_, last_params, slice_args(u_f))
+
+        if predicate_head:
+            (loss_u, mets_u), (dy_u, dlast_u) = lax.cond(
+                keep,
+                _head_eval,
+                lambda y_: jax.tree_util.tree_map(
+                    lambda s: pv(jnp.zeros(s.shape, s.dtype)), head_struct
+                ),
+                y,
+            )
+        else:
+            (loss_u, mets_u), (dy_u, dlast_u) = _head_eval(y)
         loss_acc = loss_acc + jnp.where(keep, loss_u, 0.0)
         mets_acc = _tree_add(
             mets_acc, _tree_where(keep, mets_u, _zeros_of(mets_struct))
@@ -621,22 +726,55 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
         last_chunk_b = is_last & (j_b == n_virtual - 1)
         # this B's matching F ran 2(V-1-c_b) cycles ago (same-cycle for
         # chunk V-1, whose dy seed is the one just computed above)
-        x_saved = lax.dynamic_index_in_dim(
-            stash, jnp.mod(t - 2 * (V - 1) + 2 * c_b, K), 0, keepdims=False
-        )
+        slot_b = jnp.mod(t - 2 * (V - 1) + 2 * c_b, K)
+        x_saved = lax.dynamic_index_in_dim(stash, slot_b, 0, keepdims=False)
         cot = jnp.where(last_chunk_b, dy_u, cot_in)
         params_b = pick(j_b)
-        if aux_desc is None:
-            _, vjp_fn = jax.vjp(stage_fn, params_b, x_saved)
-            dparams_u, dx_u = vjp_fn(cot)
+        if recompute:
+            with jax.named_scope("1f1b_recompute_apply"):
+                if aux_desc is None:
+                    _, vjp_fn = jax.vjp(stage_fn, params_b, x_saved)
+                    dparams_u, dx_u = vjp_fn(cot)
+                else:
+                    (_, aux_primal), vjp_fn = jax.vjp(
+                        stage_fn, params_b, x_saved
+                    )
+                    # each weight seed must carry exactly its aux output's
+                    # varying-manual-axes type (a constant aux stays
+                    # unvarying)
+                    aux_ct = jax.tree_util.tree_map(
+                        lambda w, a: pvary_like(w, a, ()), aux_weights,
+                        aux_primal,
+                    )
+                    dparams_u, dx_u = vjp_fn((cot, aux_ct))
         else:
-            (_, aux_primal), vjp_fn = jax.vjp(stage_fn, params_b, x_saved)
-            # each weight seed must carry exactly its aux output's
-            # varying-manual-axes type (a constant aux stays unvarying)
-            aux_ct = jax.tree_util.tree_map(
-                lambda w, a: pvary_like(w, a, ()), aux_weights, aux_primal
-            )
-            dparams_u, dx_u = vjp_fn((cot, aux_ct))
+            with jax.named_scope("1f1b_stash_apply"):
+                # restore the saved vjp: live param leaves + the stashed
+                # input + the ringed intermediates, rebuilt with THIS
+                # trace's treedef (the transpose program is identical
+                # every cycle; only the residual values differ)
+                p_leaves = jax.tree_util.tree_leaves(params_b)
+                ring_read = iter(
+                    lax.dynamic_index_in_dim(r, slot_b, 0, keepdims=False)
+                    for r in res_rings
+                )
+                restored = [
+                    p_leaves[i] if kind == "param"
+                    else x_saved if kind == "x"
+                    else next(ring_read)
+                    for kind, i in res_src
+                ]
+                vjp_saved = jax.tree_util.tree_unflatten(
+                    vjp_treedef, restored
+                )
+                if aux_desc is None:
+                    dparams_u, dx_u = vjp_saved(cot)
+                else:
+                    aux_ct = jax.tree_util.tree_map(
+                        lambda w, a: pvary_like(w, a, ()), aux_weights,
+                        aux_tick,
+                    )
+                    dparams_u, dx_u = vjp_saved((cot, aux_ct))
         if n_virtual == 1:
             d_stage = _tree_add(
                 d_stage,
@@ -694,17 +832,17 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
                 )
 
             in_buf = lax.cond(rot, _rotate, lambda buf: buf, in_buf)
-        return (incoming, cot_in, in_buf, stash, dx_buf, reg_dx, reg_du,
-                d_stage, d_last, loss_acc, mets_acc, aux_acc), None
-
-    def pv(x):
-        return pvary_like(x, in_buf, (axis_name,))
+        return (incoming, cot_in, in_buf, stash, res_rings, dx_buf, reg_dx,
+                reg_du, d_stage, d_last, loss_acc, mets_acc, aux_acc), None
 
     carry0 = (
         pv(jnp.zeros(mb_shape, mb_dtype)),          # incoming activation
         pv(jnp.zeros(mb_shape, mb_dtype)),          # incoming cotangent
         in_buf,
         pv(jnp.zeros((K, *mb_shape), mb_dtype)),    # input stash ring
+        () if recompute else tuple(                 # vjp-residual rings
+            pv(jnp.zeros((K, *s.shape), s.dtype)) for s in res_structs
+        ),
         pv(jnp.zeros_like(in_buf)),                 # dx out queue
         pv(jnp.zeros(mb_shape, mb_dtype)),          # dx ring register
         pv(jnp.full((), -1, jnp.int32)),            # dx ring mb index
@@ -714,7 +852,7 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
         pv(_zeros_of(mets_struct)),                 # metric sums
         pv(aux_zero) if aux_desc is not None else None,
     )
-    (_, _, _, _, dx_buf, _, _, d_stage, d_last, loss_acc, mets_acc,
+    (_, _, _, _, _, dx_buf, _, _, d_stage, d_last, loss_acc, mets_acc,
      aux_acc) = lax.scan(cycle, carry0, jnp.arange(n_cycles))[0]
 
     # loss/metrics/aux/d_last sum over pipe (masked to last-stage entries)
@@ -737,8 +875,8 @@ def _1f1b_local(stage_params, last_params, in_buf, last_args, *,
 
 
 def _1f1b_run(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
-              aux_desc, seq, n_virtual, stage_params, last_params, x_stack,
-              last_args):
+              aux_desc, seq, n_virtual, recompute, predicate_head,
+              stage_params, last_params, x_stack, last_args):
     """Trace the 1F1B shard_map; returns outputs AND gradients."""
     mets_struct = jax.eval_shape(
         lambda lp, y, a: last_fn(lp, y, a)[1],
@@ -765,7 +903,8 @@ def _1f1b_run(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
         functools.partial(
             _1f1b_local, stage_fn=stage_fn, last_fn=last_fn,
             axis_name=pipe_axis, n_micro=n_micro, aux_desc=aux_desc,
-            seq_axis=seq, n_virtual=n_virtual,
+            seq_axis=seq, n_virtual=n_virtual, recompute=recompute,
+            predicate_head=predicate_head,
         ),
         mesh=mesh,
         in_specs=(
@@ -788,23 +927,25 @@ def _1f1b_run(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
+                   nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10))
 def _1f1b_loss(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
-               aux_desc, seq, n_virtual, stage_params, last_params, x_stack,
-               last_args):
+               aux_desc, seq, n_virtual, recompute, predicate_head,
+               stage_params, last_params, x_stack, last_args):
     loss, mets, aux, _, _, _ = _1f1b_run(
         stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes, aux_desc,
-        seq, n_virtual, stage_params, last_params, x_stack, last_args,
+        seq, n_virtual, recompute, predicate_head, stage_params,
+        last_params, x_stack, last_args,
     )
     return loss, mets, aux
 
 
 def _1f1b_loss_fwd(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
-                   aux_desc, seq, n_virtual, stage_params, last_params,
-                   x_stack, last_args):
+                   aux_desc, seq, n_virtual, recompute, predicate_head,
+                   stage_params, last_params, x_stack, last_args):
     loss, mets, aux, d_stage, d_last, dx = _1f1b_run(
         stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes, aux_desc,
-        seq, n_virtual, stage_params, last_params, x_stack, last_args,
+        seq, n_virtual, recompute, predicate_head, stage_params,
+        last_params, x_stack, last_args,
     )
     int_args = jax.tree_util.tree_map(
         lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), last_args
@@ -813,7 +954,8 @@ def _1f1b_loss_fwd(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
 
 
 def _1f1b_loss_bwd(stage_fn, last_fn, mesh, n_micro, pipe_axis, data_axes,
-                   aux_desc, seq, n_virtual, res, cts):
+                   aux_desc, seq, n_virtual, recompute, predicate_head,
+                   res, cts):
     import numpy as np
 
     d_stage, d_last, dx, int_args = res
@@ -850,6 +992,8 @@ def one_f_one_b(
     aux_weights: Any = None,
     seq_axis: Optional[str] = None,
     n_virtual: int = 1,
+    recompute: bool = True,
+    predicate_head: bool = True,
 ) -> tuple:
     """1F1B pipeline train pass: per-microbatch loss computed at the last
     stage, backward interleaved one cycle behind forward.
@@ -894,6 +1038,24 @@ def one_f_one_b(
         mask instead of an in-``last_fn`` shift (the shift would cross
         chunk boundaries). Chunk-local ``jax.value_and_grad`` seeds are
         exact because softmax-CE is position-local.
+      recompute: ``True`` (default) replays the stage forward from the
+        input stash at B time (activation memory ~ the input ring only;
+        cycle cost ~4 forward-units). ``False`` stashes the stage's full
+        vjp residuals at F time in K-slot rings riding the scan carry
+        (same n_micro-independent depth ``one_f_one_b_stash_slots``) and
+        applies the STORED transpose at B — no replay, cycle cost ~3
+        forward-units, temp memory up by the residual footprint per slot.
+        Param-leaf residuals are substituted live (never ringed) and the
+        stage-input leaf reuses the existing input ring, so the extra
+        memory is the true intermediates only. Numerics are identical to
+        an ordinary ``jax.grad`` of the stage (it applies the same
+        transpose); see results/pipeline_1f1b/ for the measured frontier.
+      predicate_head: run ``last_fn`` under a per-device ``lax.cond`` so
+        only the last stage (on cycles where its forward microbatch is
+        live) evaluates the model tail. Legal because ``last_fn`` is
+        collective-free by contract; non-last stages previously computed
+        and masked the full head every cycle. Default on; the ``False``
+        arm exists for the head-cost A/B (scripts/pipeline_head_cost.py).
 
     Returns ``(loss_sum, metric_sums, aux_sums)``, differentiable wrt
     (stage_params, last_params, x).
@@ -937,5 +1099,6 @@ def one_f_one_b(
         aux_desc = (treedef, tuple(float(w) for w in leaves))
     return _1f1b_loss(
         stage_fn, last_fn, mesh, n_micro, pipe_axis, data, aux_desc, seq,
-        n_virtual, stage_params, last_params, x_stack, last_args,
+        n_virtual, bool(recompute), bool(predicate_head), stage_params,
+        last_params, x_stack, last_args,
     )

@@ -82,6 +82,12 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         help="interleaved virtual chunks per pipeline stage "
                         "(Megatron-style; needs --pipe-schedule 1f1b; "
                         "bubble time ~/v for ~v x input-stash memory)")
+    parser.add_argument("--pipe-no-recompute", action="store_true",
+                        help="1f1b backward without stage replay: stash "
+                        "each microbatch's vjp residuals at forward time "
+                        "(~3 instead of ~4 forward-units per cycle, more "
+                        "temp memory; needs --pipe-schedule 1f1b — see "
+                        "results/pipeline_1f1b/ for the measured frontier)")
     parser.add_argument("--pad-token-id", type=int, default=None,
                         help="bert: mask keys at this token id out of "
                         "attention (padding); default: no padding mask")

@@ -5,7 +5,14 @@ Round 3 shipped an untested attention change that cost ViT-B/16 29% and
 nothing caught it (VERDICT r3 #1) — this gate is the fix. It compares a
 fresh ``bench.py`` stdout line against the previous round's recorded
 ``BENCH_r*.json`` and exits non-zero (with a loud stderr report) when any
-model's throughput dropped more than ``--tolerance`` (default 5%).
+model's throughput dropped more than its tolerance.
+
+Tolerances are PER MODEL, from the committed noise floor
+(``results/bench_noise/noise.json``, written by ``scripts/bench_noise.py``
+from measured same-code v5e spread): one uniform number can't serve a
+sweep where ResNet-18 repeats within ~13% and GPT-2 within ~1% — it
+false-alarms on one and sleeps through regressions in the other. Models
+absent from the noise file fall back to ``--tolerance`` (default 5%).
 
 Usage:
     python bench.py > /tmp/bench.json 2>/tmp/bench.log
@@ -94,10 +101,27 @@ def main() -> int:
     parser.add_argument("--current", default=None,
                         help="fresh bench.py stdout (default: stdin)")
     parser.add_argument("--tolerance", type=float, default=0.05,
-                        help="allowed fractional throughput drop (0.05 = 5%%)")
+                        help="fallback fractional drop for models without "
+                        "a measured noise floor (0.05 = 5%%)")
+    parser.add_argument("--noise", default=None,
+                        help="per-model noise floor json (default: "
+                        "results/bench_noise/noise.json when present; "
+                        "'' disables)")
     args = parser.parse_args()
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    noise_path = args.noise
+    if noise_path is None:
+        cand = os.path.join(root, "results", "bench_noise", "noise.json")
+        noise_path = cand if os.path.exists(cand) else ""
+    noise_models: dict = {}
+    if noise_path:
+        with open(noise_path) as f:
+            noise_models = json.load(f).get("models", {})
+
+    def tolerance_for(name: str) -> float:
+        return noise_models.get(name, {}).get("tolerance", args.tolerance)
+
     prev_path = args.prev or _latest_bench(root)
     with open(prev_path) as f:
         prev = _extract_models(f.read(), prev_path)
@@ -129,11 +153,12 @@ def main() -> int:
             continue
         old, new = prev[name]["value"], cur[name]["value"]
         delta = (new - old) / old
+        tol = tolerance_for(name)
         line = (f"  {name}: {old:.1f} -> {new:.1f} {cur[name]['unit']} "
-                f"({delta:+.1%})")
-        if delta < -args.tolerance:
+                f"({delta:+.1%}, gate {tol:.0%})")
+        if delta < -tol:
             failures.append(name)
-            line += f"  REGRESSION (> {args.tolerance:.0%} drop)"
+            line += f"  REGRESSION (> {tol:.0%} drop)"
         # config drift makes the raw-throughput comparison apples-to-oranges
         # (exactly the r2->r3 batch/steps drift weak-spot): surface it
         pc, cc = prev[name].get("config"), cur[name].get("config")
@@ -148,6 +173,8 @@ def main() -> int:
         report.append(line)
 
     header = f"bench_gate: current vs {os.path.basename(prev_path)}"
+    if noise_models:
+        header += f" (per-model tolerances: {os.path.basename(noise_path)})"
     print(header, file=sys.stderr)
     print("\n".join(report), file=sys.stderr)
     if failures:
@@ -158,8 +185,8 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
-    print("bench_gate: OK — no model dropped more than "
-          f"{args.tolerance:.0%}", file=sys.stderr)
+    print("bench_gate: OK — no model dropped past its gate tolerance",
+          file=sys.stderr)
     return 0
 
 

@@ -44,7 +44,8 @@ import numpy as np  # noqa: E402
 MB = 1024 * 1024
 
 
-def build(schedule: str, n_micro: int, remat: bool, n_virtual: int = 1):
+def build(schedule: str, n_micro: int, remat: bool, n_virtual: int = 1,
+          recompute: bool = True):
     from distributed_pytorch_example_tpu.models.gpt2 import GPT2
     from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
 
@@ -52,19 +53,39 @@ def build(schedule: str, n_micro: int, remat: bool, n_virtual: int = 1):
         vocab_size=512, max_len=256, model_dim=256, num_layers=8,
         num_heads=8, mlp_dim=1024, pipe_axis="pipe",
         pipe_microbatches=n_micro, pipe_schedule=schedule, remat=remat,
-        pipe_virtual=n_virtual, logits_mode="hidden",
+        pipe_virtual=n_virtual, pipe_recompute=recompute,
+        logits_mode="hidden",
     ), CausalLMTask()
 
 
+def _flops(compiled) -> float:
+    """Per-device flops from XLA's cost analysis (0 if unavailable)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return 0.0
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return float(ca.get("flops", 0.0))
+
+
 def measure(schedule: str, n_micro: int, mb_size: int, seq: int,
-            remat: bool = False, n_virtual: int = 1) -> dict:
+            remat: bool = False, n_virtual: int = 1,
+            recompute: bool = True, data_span: int = 2) -> dict:
     from distributed_pytorch_example_tpu.parallel.partition import (
         transformer_partitioner,
     )
     from distributed_pytorch_example_tpu.runtime import MeshSpec, make_mesh
 
-    mesh = make_mesh(MeshSpec(data=2, pipe=4))
-    model, task = build(schedule, n_micro, remat, n_virtual)
+    # data_span=1 keeps every non-pipe axis at span 1, which makes the
+    # schedule's shard_map effectively fully manual — the one mesh shape
+    # that also compiles on pre-0.9 jax (whose SPMD partitioner rejects
+    # the PartitionId op partial-auto axis_index lowers to)
+    mesh = make_mesh(
+        MeshSpec(data=data_span, pipe=4),
+        devices=jax.devices()[: 4 * data_span],
+    )
+    model, task = build(schedule, n_micro, remat, n_virtual, recompute)
     batch = mb_size * n_micro
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, 512, size=(batch, seq)),
@@ -97,16 +118,95 @@ def measure(schedule: str, n_micro: int, mb_size: int, seq: int,
         lowered = jax.jit(
             jax.value_and_grad(loss_fn), out_shardings=(None, out_sh)
         ).lower(params, tokens)
-        stats = lowered.compile().memory_analysis()
+        compiled = lowered.compile()
+        stats = compiled.memory_analysis()
     return {
         "schedule": schedule + ("+remat" if remat else "")
-        + (f"+v{n_virtual}" if n_virtual > 1 else ""),
+        + (f"+v{n_virtual}" if n_virtual > 1 else "")
+        + ("" if recompute else "-stash"),
         "n_micro": n_micro,
         "batch": batch,
         "temp_mb": round(stats.temp_size_in_bytes / MB, 2),
         "arg_mb": round(stats.argument_size_in_bytes / MB, 2),
         "out_mb": round(stats.output_size_in_bytes / MB, 2),
+        "gflops": round(_flops(compiled) / 1e9, 3),
     }
+
+
+def _frontier_summary(rows, micros, args) -> int:
+    """The speed-memory frontier: temp MB and per-cycle compute units for
+    GPipe / 1F1B-recompute / 1F1B-stash.
+
+    XLA's CPU cost analysis counts a ``lax.scan`` (while-loop) body ONCE,
+    so a 1F1B program's "flops" is effectively the cost of one steady-state
+    cycle body (plus fixed prologue). The two 1F1B variants share an
+    identical program skeleton differing only in the B sub-tick — the
+    recompute variant's body replays exactly one stage forward that the
+    stash variant reads from its rings — so their flop DELTA is a measured
+    stage-forward unit, and ``flops / delta`` is each variant's cycle cost
+    in forward-units: the ~4 (F + recompute + bwd) vs ~3 (F + stored-vjp
+    bwd) the schedule docs quote. GPipe's skeleton (reverse-diffed scan)
+    is structurally different, so its flops are reported but not
+    normalized into cycle units.
+    """
+    from distributed_pytorch_example_tpu.parallel.pipeline import (
+        gpipe_ticks,
+        one_f_one_b_cycles,
+    )
+
+    S = 4
+    m_ref = micros[-1]
+
+    def sel(name, m):
+        return next(r for r in rows
+                    if r["schedule"] == name and r["n_micro"] == m)
+
+    def slope(name):
+        lo, hi = sel(name, micros[0]), sel(name, micros[-1])
+        return (hi["temp_mb"] - lo["temp_mb"]) / (
+            hi["n_micro"] - lo["n_micro"])
+
+    # measured stage-forward unit: the only body difference between the
+    # two 1F1B variants is the one forward replay per B sub-tick
+    unit = (sel("1f1b", m_ref)["gflops"]
+            - sel("1f1b-stash", m_ref)["gflops"])
+
+    def cycle_units(name):
+        if unit <= 0:
+            return None
+        return round(sel(name, m_ref)["gflops"] / unit, 2)
+
+    summary = {
+        "temp_mb_per_extra_microbatch": {
+            n: round(slope(n), 3) for n in ("gpipe", "1f1b", "1f1b-stash")
+        },
+        "temp_mb_at_m_ref": {
+            n: sel(n, m_ref)["temp_mb"]
+            for n in ("gpipe", "1f1b", "1f1b-stash")
+        },
+        "gflops_at_m_ref": {
+            n: sel(n, m_ref)["gflops"]
+            for n in ("gpipe", "1f1b", "1f1b-stash")
+        },
+        "stage_fwd_unit_gflops": round(unit, 4),
+        "cycle_cost_forward_units": {
+            n: cycle_units(n) for n in ("1f1b", "1f1b-stash")
+        },
+        "schedule_length": {
+            "gpipe_ticks": gpipe_ticks(m_ref, S),
+            "one_f_one_b_cycles": one_f_one_b_cycles(m_ref, S),
+        },
+        "n_micro_ref": m_ref,
+        "config": {"mb_size": args.mb_size, "seq": args.seq,
+                   "mesh": f"data={args.data_span} x pipe=4",
+                   "model": "gpt2 256d x 8L", "jax": jax.__version__},
+    }
+    print(json.dumps(summary), flush=True)
+    if args.json:
+        os.makedirs(os.path.dirname(args.json), exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump({"rows": rows, "summary": summary}, f, indent=1)
+    return 0
 
 
 def main() -> int:
@@ -115,17 +215,33 @@ def main() -> int:
     parser.add_argument("--mb-size", type=int, default=4)
     parser.add_argument("--seq", type=int, default=128)
     parser.add_argument("--json", default=None)
+    parser.add_argument("--data-span", type=int, default=2)
+    parser.add_argument(
+        "--stash-frontier", action="store_true",
+        help="measure the speed-memory frontier instead: GPipe vs "
+             "1F1B-recompute vs 1F1B-stash (pipe_recompute=False), with "
+             "per-device flops alongside temp memory",
+    )
     args = parser.parse_args()
 
     micros = [int(m) for m in args.micros.split(",")]
+    if args.stash_frontier:
+        variants = (("gpipe", False, 1, True), ("1f1b", False, 1, True),
+                    ("1f1b", False, 1, False))
+    else:
+        variants = (("gpipe", False, 1, True), ("gpipe", True, 1, True),
+                    ("1f1b", False, 1, True), ("1f1b", False, 2, True))
     rows = []
-    for schedule, remat, v in (("gpipe", False, 1), ("gpipe", True, 1),
-                               ("1f1b", False, 1), ("1f1b", False, 2)):
+    for schedule, remat, v, rc in variants:
         for m in micros:
             row = measure(schedule, m, args.mb_size, args.seq, remat=remat,
-                          n_virtual=v)
+                          n_virtual=v, recompute=rc,
+                          data_span=args.data_span)
             rows.append(row)
             print(json.dumps(row), flush=True)
+
+    if args.stash_frontier:
+        return _frontier_summary(rows, micros, args)
 
     # the claim under measurement: GPipe's temp grows with n_micro much
     # faster than 1F1B's (whose activation stash is m-independent)
@@ -162,7 +278,8 @@ def main() -> int:
             "n_micro": m_ref,
         },
         "config": {"mb_size": args.mb_size, "seq": args.seq,
-                   "mesh": "data=2 x pipe=4", "model": "gpt2 256d x 8L"},
+                   "mesh": f"data={args.data_span} x pipe=4",
+                   "model": "gpt2 256d x 8L"},
     }
     print(json.dumps(summary), flush=True)
     if args.json:

@@ -41,8 +41,12 @@ def _payload(models):
 def _run_gate(prev, cur, tmp_path, extra=()):
     prev_path = tmp_path / "prev.json"
     prev_path.write_text(json.dumps(prev))
+    # --noise '' keeps these hermetic: without it the gate auto-discovers
+    # the repo's committed results/bench_noise/noise.json and these
+    # fixture models would pick up the real per-model tolerances
     proc = subprocess.run(
-        [sys.executable, GATE, "--prev", str(prev_path), *extra],
+        [sys.executable, GATE, "--prev", str(prev_path), "--noise", "",
+         *extra],
         input=json.dumps(cur), capture_output=True, text=True,
     )
     return proc.returncode, proc.stderr
@@ -163,6 +167,40 @@ def test_tolerance_flag(tmp_path):
     cur = _payload({"resnet50": _model("resnet50", 900.0)})
     rc, _ = _run_gate(prev, cur, tmp_path, extra=("--tolerance", "0.15"))
     assert rc == 0
+
+
+def test_per_model_noise_tolerances(tmp_path):
+    """The measured noise floor gates per model: a drop inside a noisy
+    model's floor passes while a smaller drop past a quiet model's floor
+    fails — one uniform tolerance can't do both."""
+    noise_path = tmp_path / "noise.json"
+    noise_path.write_text(json.dumps({
+        "models": {
+            "resnet18": {"tolerance": 0.14},
+            "vit-b16": {"tolerance": 0.03},
+        }
+    }))
+    prev = _payload({
+        "resnet18": _model("resnet18", 1000.0),
+        "vit-b16": _model("vit-b16", 1000.0),
+    })
+    cur = _payload({
+        "resnet18": _model("resnet18", 900.0),  # -10%: inside its 14% floor
+        "vit-b16": _model("vit-b16", 960.0),    # -4%: past its 3% floor
+    })
+    prev_path = tmp_path / "prev.json"
+    prev_path.write_text(json.dumps(prev))
+    proc = subprocess.run(
+        [sys.executable, GATE, "--prev", str(prev_path),
+         "--noise", str(noise_path)],
+        input=json.dumps(cur), capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    lines = {ln.strip().split(":")[0]: ln for ln in proc.stderr.splitlines()
+             if ln.strip().startswith(("resnet18", "vit-b16"))}
+    assert "REGRESSION" in lines["vit-b16"]
+    assert "REGRESSION" not in lines["resnet18"]
+    assert "gate 14%" in lines["resnet18"]
 
 
 def test_latest_bench_sorts_numerically(tmp_path):

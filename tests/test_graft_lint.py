@@ -243,6 +243,48 @@ def test_compare_budgets_new_kind_and_improvement():
     assert any("improvement" in n for n in notes)  # the decrease is a note
 
 
+def test_parse_markers_greps_named_scopes():
+    text = (
+        'HloModule m\n fusion.1 = f32[4]{0} fusion(...), metadata='
+        '{op_name="jit(step)/transpose/1f1b_stash_apply/dot_general"}\n'
+    )
+    assert coll.parse_markers(text) == {
+        "1f1b_stash_apply": True, "1f1b_recompute_apply": False,
+    }
+
+
+def test_compare_budgets_stash_signature():
+    """The 1f1b-stash structural contract: the stash marker must be
+    present and the recompute marker absent — byte/count budgets cannot
+    catch a silent fallback (it changes no collective at all)."""
+    committed = {"collective-permute": {"count": 4, "bytes": 100}}
+    measured = {"collective-permute": {"count": 4, "bytes": 100}}
+    ok = {"1f1b_stash_apply": True, "1f1b_recompute_apply": False}
+    fell_back = {"1f1b_stash_apply": False, "1f1b_recompute_apply": True}
+
+    v, _ = coll.compare_budgets(
+        committed, measured, signature="1f1b-stash", markers=ok
+    )
+    assert v == []
+    v, _ = coll.compare_budgets(
+        committed, measured, signature="1f1b-stash", markers=fell_back
+    )
+    assert _rules(v) == [
+        "comm-1f1b-stash-signature", "comm-1f1b-stash-signature"
+    ]
+    assert {f.where for f in v} == {
+        "1f1b_stash_apply", "1f1b_recompute_apply"
+    }
+    # no markers at all (e.g. a hand-edited budget refresh): still loud
+    v, _ = coll.compare_budgets(
+        committed, measured, signature="1f1b-stash", markers=None
+    )
+    assert _rules(v) == ["comm-1f1b-stash-signature"]
+    # without the signature the same marker drift is invisible
+    assert coll.compare_budgets(committed, measured, markers=fell_back)[0] \
+        == []
+
+
 # ---------------------------------------------------------------------------
 # jaxpr numerics lint
 # ---------------------------------------------------------------------------

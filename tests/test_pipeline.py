@@ -323,6 +323,174 @@ def test_1f1b_interleaved_matches_sequential(devices, S, v):
         )
 
 
+@pytest.mark.parametrize("predicate_head", [True, False])
+def test_1f1b_stash_matches_sequential(devices, predicate_head):
+    """recompute=False (activation-stash backward): the B sub-tick applies
+    the vjp captured at forward time from the residual rings instead of
+    replaying the stage forward. Same bar as test_1f1b_matches_sequential
+    (4 stages x 8 microbatches, loss + metrics + ALL grads vs the
+    microbatched sequential reference), both with and without the
+    last-stage head predication (lax.cond vs where-masked head)."""
+    from distributed_pytorch_example_tpu.parallel.pipeline import one_f_one_b
+
+    S, m, dim, n_cls = 4, 8, 16, 5
+    mesh = make_mesh(MeshSpec(data=2, pipe=S))
+    block, per_stage, stacked, stage_fn = make_stages(S, dim=dim)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((16, dim)), jnp.float32)
+    tgt = jnp.asarray(rng.integers(0, n_cls, size=(16,)), jnp.int32)
+    head_w = jnp.asarray(rng.standard_normal((dim, n_cls)), jnp.float32)
+
+    def loss_pipe(sp, hw, xx):
+        with mesh:
+            loss_sum, mets, _ = one_f_one_b(
+                stage_fn, sp, xx, mesh, m,
+                last_fn=_softmax_last_fn, last_params=hw, last_args=tgt,
+                recompute=False, predicate_head=predicate_head,
+            )
+        return loss_sum / m, mets
+
+    def loss_seq(sp, hw, xx):
+        mb = xx.reshape(m, -1, dim)
+        tb = tgt.reshape(m, -1)
+        total, ncorrect = 0.0, 0.0
+        for i in range(m):
+            y = mb[i]
+            for s in range(S):
+                p = jax.tree_util.tree_map(lambda l: l[s], sp)
+                y = stage_fn(p, y)
+            l, mets = _softmax_last_fn(hw, y, tb[i])
+            total = total + l
+            ncorrect = ncorrect + mets["correct"]
+        return total / m, ncorrect
+
+    (lp, mets), g_pipe = jax.value_and_grad(
+        loss_pipe, argnums=(0, 1, 2), has_aux=True
+    )(stacked, head_w, x)
+    (ls, ncorrect), g_seq = jax.value_and_grad(
+        loss_seq, argnums=(0, 1, 2), has_aux=True
+    )(stacked, head_w, x)
+    np.testing.assert_allclose(float(lp), float(ls), rtol=1e-5)
+    assert float(mets["correct"]) == float(ncorrect)
+    for a, b in zip(g_pipe, g_seq):
+        jax.tree_util.tree_map(
+            lambda u, v: np.testing.assert_allclose(
+                np.asarray(u), np.asarray(v), atol=3e-5
+            ),
+            a, b,
+        )
+
+
+def test_1f1b_stash_interleaved_matches_sequential(devices):
+    """Interleaved (virtual-chunk) 1F1B with recompute=False: the stash
+    rings are CHUNK-granular (slot arithmetic over V = S*v chunks, ring
+    depth one_f_one_b_stash_slots(S, v)) and the restored vjps must pick
+    the right chunk's params at B time. Same reference and tolerances as
+    test_1f1b_interleaved_matches_sequential at S=2, v=2."""
+    from distributed_pytorch_example_tpu.parallel.pipeline import one_f_one_b
+
+    S, v, m, dim, n_cls = 2, 2, 8, 16, 5
+    V = S * v
+    mesh = make_mesh(MeshSpec(data=8 // S, pipe=S))
+    block, per_chunk, stacked_V, stage_fn = make_stages(V, dim=dim)
+    interleaved = jax.tree_util.tree_map(
+        lambda p: jnp.swapaxes(p.reshape(v, S, *p.shape[1:]), 0, 1),
+        stacked_V,
+    )
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((32, dim)), jnp.float32)
+    tgt = jnp.asarray(rng.integers(0, n_cls, size=(32,)), jnp.int32)
+    head_w = jnp.asarray(rng.standard_normal((dim, n_cls)), jnp.float32)
+
+    def loss_pipe(sp, hw, xx):
+        with mesh:
+            loss_sum, mets, _ = one_f_one_b(
+                stage_fn, sp, xx, mesh, m,
+                last_fn=_softmax_last_fn, last_params=hw, last_args=tgt,
+                n_virtual=v, recompute=False,
+            )
+        return loss_sum / m, mets
+
+    def loss_seq(sp, hw, xx):
+        spV = jax.tree_util.tree_map(
+            lambda p: jnp.swapaxes(p, 0, 1).reshape(V, *p.shape[2:]), sp
+        )
+        mb = xx.reshape(m, -1, dim)
+        tb = tgt.reshape(m, -1)
+        total, ncorrect = 0.0, 0.0
+        for i in range(m):
+            y = mb[i]
+            for c in range(V):
+                p = jax.tree_util.tree_map(lambda l: l[c], spV)
+                y = stage_fn(p, y)
+            l, mets = _softmax_last_fn(hw, y, tb[i])
+            total = total + l
+            ncorrect = ncorrect + mets["correct"]
+        return total / m, ncorrect
+
+    (lp, mets), g_pipe = jax.value_and_grad(
+        loss_pipe, argnums=(0, 1, 2), has_aux=True
+    )(interleaved, head_w, x)
+    (ls, ncorrect), g_seq = jax.value_and_grad(
+        loss_seq, argnums=(0, 1, 2), has_aux=True
+    )(interleaved, head_w, x)
+    np.testing.assert_allclose(float(lp), float(ls), rtol=1e-5)
+    assert float(mets["correct"]) == float(ncorrect)
+    for a, b in zip(g_pipe, g_seq):
+        jax.tree_util.tree_map(
+            lambda u, v_: np.testing.assert_allclose(
+                np.asarray(u), np.asarray(v_), atol=3e-5
+            ),
+            a, b,
+        )
+
+
+def test_1f1b_stash_temp_memory_n_micro_independent(devices):
+    """The vjp-residual rings hold IN-FLIGHT microbatches only (K =
+    one_f_one_b_stash_slots slots), so the stash mode's temp-memory
+    overhead over recompute mode must NOT grow with n_micro: the extra
+    temp bytes at m=32 stay within 1.5x the extra at m=8 (a per-microbatch
+    stash would 4x it). Uses a pipe-ONLY mesh so the measurement compiles
+    on every supported jax (partial-auto shard_map pipelines need the
+    0.9 toolchain; fully-manual ones do not)."""
+    from jax.sharding import Mesh
+    from distributed_pytorch_example_tpu.parallel.pipeline import one_f_one_b
+
+    S, dim, n_cls = 4, 64, 17
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pipe",))
+    block, per_stage, stacked, stage_fn = make_stages(S, dim=dim)
+    rng = np.random.default_rng(0)
+    head_w = jnp.asarray(rng.standard_normal((dim, n_cls)), jnp.float32)
+
+    def temp_bytes(m, recompute):
+        x = jnp.asarray(rng.standard_normal((4 * m, dim)), jnp.float32)
+        tgt = jnp.asarray(rng.integers(0, n_cls, size=(4 * m,)), jnp.int32)
+
+        def loss_pipe(sp, hw, xx):
+            with mesh:
+                loss_sum, _, _ = one_f_one_b(
+                    stage_fn, sp, xx, mesh, m,
+                    last_fn=_softmax_last_fn, last_params=hw, last_args=tgt,
+                    recompute=recompute,
+                )
+            return loss_sum / m
+
+        compiled = jax.jit(
+            jax.value_and_grad(loss_pipe, argnums=(0, 1, 2))
+        ).lower(stacked, head_w, x).compile()
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    rec8, rec32 = temp_bytes(8, True), temp_bytes(32, True)
+    st8, st32 = temp_bytes(8, False), temp_bytes(32, False)
+    extra8, extra32 = st8 - rec8, st32 - rec32
+    # the rings exist (stash mode does pay a constant memory price) ...
+    assert extra8 > 0, (st8, rec8)
+    # ... but that price is n_micro-independent: 4x the microbatches may
+    # not grow it more than 1.5x (queues shared with recompute mode are
+    # differenced away; a ring scaling with m would show ~4x here)
+    assert extra32 < 1.5 * extra8, (extra8, extra32)
+
+
 def test_1f1b_interleaved_schedule_formulas():
     """Interleaved cycle/stash/bubble pinned: at v=1 everything reduces to
     the classic 1F1B numbers; at v>1 cycles are CHUNK-granular (~1/v the

@@ -621,6 +621,181 @@ def test_sp_pp_1f1b_matches_dense_pipelined(devices, family):
     )
 
 
+def test_1f1b_stash_composes_with_tensor_parallelism(devices):
+    """pipe_recompute=False under data x pipe x tensor: the stashed vjp
+    residuals are TP-sharded arrays riding through the pipe-manual scan
+    carry while Megatron TP stays automatic inside the stage, exactly as
+    with the recompute backward — and the two backward modes produce the
+    SAME loss trajectory from the same init."""
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+    from distributed_pytorch_example_tpu.data.synthetic import (
+        SyntheticTokenDataset,
+    )
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+    from distributed_pytorch_example_tpu.parallel.partition import (
+        transformer_partitioner,
+    )
+    from distributed_pytorch_example_tpu.train.loop import Trainer
+    from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
+
+    mesh = make_mesh(MeshSpec(data=2, pipe=2, tensor=2))
+    dataset = SyntheticTokenDataset(num_samples=32, seq_len=16, vocab_size=64)
+
+    def run(recompute):
+        model = GPT2(
+            vocab_size=64, max_len=32, model_dim=32, num_layers=2,
+            num_heads=4, mlp_dim=64, pipe_axis="pipe", pipe_schedule="1f1b",
+            pipe_microbatches=2, pipe_recompute=recompute,
+            logits_mode="hidden",
+        )
+        loader = DeviceLoader(dataset, 8, mesh=mesh, num_shards=1, shard_id=0)
+        trainer = Trainer(
+            model, CausalLMTask(), optax.adam(1e-2),
+            partitioner=transformer_partitioner(mesh),
+        )
+        losses = []
+        with mesh:
+            trainer.init(next(iter(loader))["tokens"])
+            q_sharding = trainer.state.params["decoder"]["q_kernel"].sharding
+            assert "tensor" in tuple(q_sharding.spec)
+            state = trainer.state
+            for _ in range(3):
+                state, m = trainer.train_step(state, next(iter(loader)))
+                losses.append(float(m["loss"]))
+        return losses
+
+    l_stash, l_rec = run(False), run(True)
+    assert all(np.isfinite(l) for l in l_stash)
+    assert l_stash[-1] < l_stash[0], l_stash
+    np.testing.assert_allclose(l_stash, l_rec, rtol=1e-5)
+
+
+@pytest.mark.parametrize("recompute", [True, False])
+def test_sp_pp_interleaved_1f1b_matches_dense_pipelined(devices, recompute):
+    """INTERLEAVED (pipe_virtual=2) 1F1B x SP: chunk-granular stash-ring
+    arithmetic composes with the {pipe, sequence}-manual schedule — loss,
+    accuracy sums, and grads equal the same interleaved model on a
+    sequence-span-1 mesh, under BOTH backward modes (recompute and
+    activation-stash)."""
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+    from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
+
+    mesh_sp = make_mesh(MeshSpec(data=2, pipe=2, sequence=2))
+    mesh_dense = make_mesh(MeshSpec(data=4, pipe=2))
+    task = CausalLMTask()
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(16, 16)), jnp.int32
+    )
+    mk = lambda sp: GPT2(
+        vocab_size=64, max_len=32, model_dim=32, num_layers=4, num_heads=4,
+        mlp_dim=64, pipe_axis="pipe", pipe_schedule="1f1b",
+        pipe_microbatches=4, pipe_virtual=2, pipe_recompute=recompute,
+        sp_mode="ring", seq_axis=sp, logits_mode="hidden",
+    )
+    m_sp, m_dense = mk("sequence"), mk(None)
+    with mesh_sp:
+        params = m_sp.init(jax.random.key(0), tokens, train=False)["params"]
+    rng = jax.random.key(1)
+
+    def loss(model, mesh):
+        def f(p):
+            with mesh:
+                l, mets, _ = task.compute_loss(
+                    model, p, {}, {"tokens": tokens}, rng, train=True
+                )
+            return l, mets
+
+        return f
+
+    (l_sp, mets_sp), g_sp = jax.value_and_grad(
+        loss(m_sp, mesh_sp), has_aux=True
+    )(params)
+    (l_d, mets_d), g_d = jax.value_and_grad(
+        loss(m_dense, mesh_dense), has_aux=True
+    )(params)
+    np.testing.assert_allclose(float(l_sp), float(l_d), rtol=3e-5)
+    np.testing.assert_allclose(
+        float(mets_sp["accuracy"]), float(mets_d["accuracy"]), atol=1e-3
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4
+        ),
+        g_sp, g_d,
+    )
+
+
+@pytest.mark.parametrize("save_recompute", [True, False])
+def test_checkpoint_resume_across_pipe_recompute_flip(
+    tmp_path, devices, save_recompute
+):
+    """A checkpoint saved under one 1F1B backward mode resumes under the
+    other with the SAME loss trajectory (both flip directions): the vjp
+    stash is schedule state inside a single step, never train state, so
+    the checkpoint format is mode-independent — the two modes' TrainState
+    treedefs are identical."""
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+    from distributed_pytorch_example_tpu.data.synthetic import (
+        SyntheticTokenDataset,
+    )
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+    from distributed_pytorch_example_tpu.parallel.partition import (
+        transformer_partitioner,
+    )
+    from distributed_pytorch_example_tpu.train.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+    from distributed_pytorch_example_tpu.train.loop import Trainer
+    from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
+
+    mesh = make_mesh(MeshSpec(data=2, pipe=2))
+    dataset = SyntheticTokenDataset(num_samples=64, seq_len=16, vocab_size=64)
+    loader = DeviceLoader(dataset, 16, mesh=mesh, num_shards=1, shard_id=0)
+    batches = [b for _, b in zip(range(4), iter(loader))]
+
+    def make(recompute):
+        model = GPT2(
+            vocab_size=64, max_len=32, model_dim=32, num_layers=2,
+            num_heads=4, mlp_dim=64, pipe_axis="pipe", pipe_schedule="1f1b",
+            pipe_microbatches=4, pipe_recompute=recompute,
+            logits_mode="hidden",
+        )
+        trainer = Trainer(
+            model, CausalLMTask(), optax.adam(1e-2),
+            partitioner=transformer_partitioner(mesh),
+        )
+        with mesh:
+            trainer.init(batches[0]["tokens"])
+        return trainer
+
+    t_save, t_flip = make(save_recompute), make(not save_recompute)
+    # mode-independent checkpoint format: identical state treedef
+    assert jax.tree_util.tree_structure(
+        t_save.state
+    ) == jax.tree_util.tree_structure(t_flip.state)
+
+    state = t_save.state
+    with mesh:
+        for b in batches[:2]:
+            state, _ = t_save.train_step(state, b)
+    path = str(tmp_path / "flip.ckpt")
+    save_checkpoint(path, state, epoch=1, loss=0.0)
+
+    def resume(trainer):
+        st, epoch, _ = load_checkpoint(path, trainer.state)
+        assert epoch == 1
+        losses = []
+        with mesh:
+            for b in batches[2:]:
+                st, m = trainer.train_step(st, b)
+                losses.append(float(m["loss"]))
+        return losses
+
+    l_flip, l_cont = resume(t_flip), resume(t_save)
+    np.testing.assert_allclose(l_flip, l_cont, rtol=1e-6)
+
+
 def test_interleaved_1f1b_moe_matches_plain(devices):
     """PP x EP under INTERLEAVED 1F1B (pipe_virtual=2): the per-cycle aux
     accumulation and in-schedule aux-gradient seeding behave identically

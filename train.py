@@ -256,10 +256,19 @@ def main():
                 parser.error("--pipe-virtual needs --pipe-schedule 1f1b "
                              "(interleaving is a 1F1B refinement)")
             overrides["pipe_virtual"] = args.pipe_virtual
+        if args.pipe_no_recompute:
+            if args.pipe_schedule != "1f1b":
+                parser.error("--pipe-no-recompute needs --pipe-schedule "
+                             "1f1b (GPipe differentiates through the whole "
+                             "schedule; the stash is a 1F1B backward mode)")
+            overrides["pipe_recompute"] = False
     elif args.pipe_schedule != "gpipe":
         parser.error("--pipe-schedule 1f1b needs --mesh-pipe > 1")
     elif args.pipe_virtual > 1:
         parser.error("--pipe-virtual needs --mesh-pipe > 1 and "
+                     "--pipe-schedule 1f1b")
+    elif args.pipe_no_recompute:
+        parser.error("--pipe-no-recompute needs --mesh-pipe > 1 and "
                      "--pipe-schedule 1f1b")
     model = dpx.models.get_model(args.model, **overrides)
     task = build_task(args, model)
