@@ -44,5 +44,6 @@ from distributed_pytorch_example_tpu import data  # noqa: F401
 from distributed_pytorch_example_tpu import models  # noqa: F401
 from distributed_pytorch_example_tpu import ops  # noqa: F401
 from distributed_pytorch_example_tpu import parallel  # noqa: F401
+from distributed_pytorch_example_tpu import robustness  # noqa: F401
 from distributed_pytorch_example_tpu import train  # noqa: F401
 from distributed_pytorch_example_tpu import utils  # noqa: F401

@@ -1,6 +1,6 @@
 """Repo-specific Python AST lints (no jax import, no backend).
 
-Five rules, each a distilled past-regression class:
+Six rules, each a distilled past-regression class:
 
 - ``host-sync``: ``.item()`` / ``np.asarray`` / ``jax.device_get`` inside
   TRACED-SCOPE sources (``ops/``, ``models/``, ``parallel/``,
@@ -32,6 +32,14 @@ Five rules, each a distilled past-regression class:
   perturbs XLA scheduling. Step telemetry goes through the graft-scope
   sentinel struct (``telemetry/sentinels.py``): on-device scalars fetched
   once per log boundary.
+- ``nan-launder``: any ``nan_to_num`` call inside ``ops/`` or ``train/``.
+  Replacing NaN/Inf with zeros SILENCES the fault instead of surfacing
+  it: the sentinel struct stops counting, the bad-step predication in
+  train/step.py never fires, and a diverging run keeps training on
+  laundered garbage. The sanctioned recovery path is detection
+  (``telemetry/sentinels.py``) + device-side update predication + the
+  Trainer's bounded bad-step budget (graft-armor) — never value
+  rewriting. Deliberate exceptions carry ``# graft-lint: nan-launder``.
 
 Scope is static and name-based, not a whole-program call graph — the
 cheap 99% of the check. Deliberate exceptions carry a
@@ -54,6 +62,7 @@ TRACED_SCOPE = (
 MESH_GUESS_SCOPE = ("ops/",)
 BF16_ACCUM_SCOPE = ("ops/", "train/")
 DEBUG_CALLBACK_SCOPE = ("ops/", "train/step.py")
+NAN_LAUNDER_SCOPE = ("ops/", "train/")
 
 _ACCUM_CTORS = ("zeros", "zeros_like", "full", "empty")
 
@@ -211,6 +220,7 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
     traced = _in_scope(relpath, TRACED_SCOPE)
     mesh_scope = _in_scope(relpath, MESH_GUESS_SCOPE)
     debug_scope = _in_scope(relpath, DEBUG_CALLBACK_SCOPE)
+    nan_scope = _in_scope(relpath, NAN_LAUNDER_SCOPE)
 
     visitor = _FuncStack()
     sharding_aware: Dict[ast.AST, bool] = {}
@@ -277,6 +287,25 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
                             "(telemetry/sentinels.py) instead"
                         ),
                     ))
+        if nan_scope:
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name == "nan_to_num" and not _suppressed(
+                supp, node.lineno, "nan-launder"
+            ):
+                findings.append(Finding(
+                    rule="nan-launder",
+                    where=f"{relpath}:{node.lineno}",
+                    message=(
+                        "nan_to_num(...) launders nonfinite values into "
+                        "zeros, hiding the fault from the sentinel struct "
+                        "and the bad-step predication; let detection + "
+                        "update skipping (graft-armor) handle nonfinite "
+                        "steps instead"
+                    ),
+                ))
         if mesh_scope:
             fn = node.func
             name = fn.attr if isinstance(fn, ast.Attribute) else (

@@ -1,0 +1,38 @@
+"""graft-armor: self-healing recovery + deterministic fault injection.
+
+Two halves that validate each other (ISSUE 5):
+
+- recovery surfaces threaded through the stack — checkpoint integrity
+  envelopes with keep-last-K retention and automatic fallback
+  (``train/checkpoint.py``), device-side bad-step predication with a
+  bounded skip budget and rollback (``train/step.py`` + ``train/loop.py``),
+  bounded retry on rendezvous and checkpoint I/O (:mod:`.retry`);
+- the chaos harness (:mod:`.chaos`) that injects seeded, replayable
+  faults at exactly those surfaces so every recovery path is provable
+  (``tests/test_chaos.py``, ``scripts/chaos_sweep.py``).
+"""
+
+from distributed_pytorch_example_tpu.robustness.chaos import (  # noqa: F401
+    ChaosPlan,
+    Fault,
+)
+from distributed_pytorch_example_tpu.robustness.integrity import (  # noqa: F401
+    CheckpointCorruptError,
+    read_verified,
+    seal,
+    unseal,
+)
+from distributed_pytorch_example_tpu.robustness.retry import (  # noqa: F401
+    with_retries,
+)
+
+
+class BadStepBudgetExceeded(RuntimeError):
+    """Nonfinite-step skips exhausted ``max_bad_steps`` after a rollback.
+
+    Raised by the Trainer when the predicated update has skipped more
+    nonfinite steps than the budget allows AND a one-shot rollback to the
+    last good checkpoint already happened (or no checkpoint exists): the
+    fault is persistent — diverged optimization, bad data shard, real
+    numerics bug — and retrying further would only burn accelerator time.
+    """

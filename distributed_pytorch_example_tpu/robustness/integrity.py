@@ -1,0 +1,72 @@
+"""Checkpoint integrity envelope: CRC32-sealed msgpack blobs.
+
+Every checkpoint artifact (gathered payload, shard file, manifest) is
+written wrapped in a tiny self-describing envelope::
+
+    b"DPX-CRC1\\n" + <4-byte little-endian crc32 of body> + <body>
+
+so the loader can distinguish "file exists but is torn/bit-flipped" from
+"file is intact" BEFORE msgpack parsing — a truncated msgpack blob can
+deserialize into a silently wrong pytree, which is far worse than a loud
+failure. Per-shard (not per-checkpoint) sealing matters because the
+sharded format has no single writer: each process seals its own shard, so
+one corrupt shard file is attributable and the fallback walk (see
+``train/checkpoint.py``) can skip just that checkpoint version.
+
+Files written before this envelope existed (no magic prefix) pass through
+``unseal`` unverified — old checkpoints stay loadable.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+ENVELOPE_MAGIC = b"DPX-CRC1\n"
+_CRC_LEN = 4
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint artifact failed integrity verification."""
+
+
+def seal(body: bytes) -> bytes:
+    """Wrap ``body`` in the CRC envelope."""
+    return ENVELOPE_MAGIC + struct.pack("<I", zlib.crc32(body)) + body
+
+
+def is_sealed(data: bytes) -> bool:
+    return data[: len(ENVELOPE_MAGIC)] == ENVELOPE_MAGIC
+
+
+def unseal(data: bytes, source: str = "<bytes>") -> bytes:
+    """Verify and strip the envelope; legacy (unsealed) data passes through.
+
+    Raises :class:`CheckpointCorruptError` on a truncated envelope or a
+    CRC mismatch, naming ``source`` so the fallback walk can log exactly
+    which artifact was bad.
+    """
+    if not is_sealed(data):
+        return data  # pre-envelope checkpoint: loadable, unverified
+    header = len(ENVELOPE_MAGIC) + _CRC_LEN
+    if len(data) < header:
+        raise CheckpointCorruptError(
+            f"{source}: truncated integrity envelope "
+            f"({len(data)} bytes < {header}-byte header)"
+        )
+    (expect,) = struct.unpack_from("<I", data, len(ENVELOPE_MAGIC))
+    body = data[header:]
+    actual = zlib.crc32(body)
+    if actual != expect:
+        raise CheckpointCorruptError(
+            f"{source}: checksum mismatch (stored crc32={expect:#010x}, "
+            f"computed {actual:#010x}, body {len(body)} bytes) — torn or "
+            f"bit-flipped write"
+        )
+    return body
+
+
+def read_verified(path: str) -> bytes:
+    """Read ``path`` and return its verified body (legacy passes through)."""
+    with open(path, "rb") as f:
+        return unseal(f.read(), source=path)

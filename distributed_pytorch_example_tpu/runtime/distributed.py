@@ -108,33 +108,67 @@ def resolve_config(env: Optional[dict] = None) -> DistributedConfig:
     return DistributedConfig(num_processes, int(process_id), coordinator)
 
 
-def initialize(config: Optional[DistributedConfig] = None) -> DistributedConfig:
+def initialize(
+    config: Optional[DistributedConfig] = None,
+    max_attempts: Optional[int] = None,
+) -> DistributedConfig:
     """Join the multi-host job (reference ``setup_distributed``, train.py:70-82).
 
     No-op for single-process topologies; idempotent.
+
+    The coordinator rendezvous is retried with bounded exponential backoff
+    (graft-armor): hosts of a preempted-and-rescheduled job come up at
+    different times, and the first connect to a coordinator that is not
+    listening yet is a TRANSIENT failure, not a config error. Knobs:
+    ``max_attempts`` (default ``$DPX_RENDEZVOUS_RETRIES`` + 1 = 4 total)
+    and ``$DPX_RENDEZVOUS_BACKOFF`` (base delay seconds, default 1.0).
     """
     global _initialized
+    # function-local import: robustness must stay importable before the
+    # runtime package finishes initializing (no cycle at module load)
+    from distributed_pytorch_example_tpu.robustness import chaos, retry
+
     if config is None:
         config = resolve_config()
     if _initialized:
         return config
-    if config.is_distributed:
-        import jax
+    if max_attempts is None:
+        max_attempts = int(os.environ.get("DPX_RENDEZVOUS_RETRIES", "3")) + 1
 
-        jax.distributed.initialize(
-            coordinator_address=config.coordinator_address,
-            num_processes=config.num_processes,
-            process_id=config.process_id,
-        )
-        logger.info(
-            "Initialized distributed runtime: process_id=%d, num_processes=%d, "
-            "coordinator=%s",
-            config.process_id,
-            config.num_processes,
-            config.coordinator_address,
-        )
-    else:
-        logger.info("Single-process mode (no rendezvous needed)")
+    def _join():
+        # deterministic fault injection (no-op without a chaos plan); sits
+        # INSIDE the retried callable so the single-process path exercises
+        # the same retry loop the multi-host rendezvous uses
+        chaos.transient_failure("rendezvous")
+        if config.is_distributed:
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=config.coordinator_address,
+                num_processes=config.num_processes,
+                process_id=config.process_id,
+            )
+            logger.info(
+                "Initialized distributed runtime: process_id=%d, "
+                "num_processes=%d, coordinator=%s",
+                config.process_id,
+                config.num_processes,
+                config.coordinator_address,
+            )
+        else:
+            logger.info("Single-process mode (no rendezvous needed)")
+
+    retry.with_retries(
+        _join,
+        attempts=max_attempts,
+        base_delay=float(os.environ.get("DPX_RENDEZVOUS_BACKOFF", "1.0")),
+        max_delay=30.0,
+        # jax.distributed surfaces coordinator-unreachable as RuntimeError
+        # (grpc DEADLINE_EXCEEDED/UNAVAILABLE) depending on version; plain
+        # socket errors ride OSError/ConnectionError
+        retry_on=(RuntimeError, OSError, ConnectionError),
+        describe="coordinator rendezvous",
+    )
     _initialized = True
     return config
 

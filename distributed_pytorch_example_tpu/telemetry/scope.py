@@ -84,6 +84,7 @@ class Telemetry:
         self.last_record: Dict[str, object] = {}
         self.last_straggler: Dict[str, object] = {}
         self.overhead_s = 0.0
+        self.events: list = []  # recovery/fault events (graft-armor)
         self._closed = False
 
     # -- spans ------------------------------------------------------------
@@ -124,6 +125,21 @@ class Telemetry:
                 "collectives": rec.get("collectives"),
             })
         return rec
+
+    # -- recovery events --------------------------------------------------
+
+    def record_event(self, kind: str, **fields) -> Dict[str, object]:
+        """First-class recovery record (graft-armor): bad-step skips,
+        rollbacks, checkpoint fallbacks, retried I/O. Written to the
+        metrics JSONL unconditionally (recovery events are rare and
+        operationally load-bearing — unlike the per-N-step records they
+        are not gated on ``config.every``) and kept on ``self.events``
+        for the close() summary."""
+        record: Dict[str, object] = {"event": kind, **fields}
+        self.events.append(record)
+        if self.writer is not None:
+            self.writer.write(record)
+        return record
 
     # -- per-step ---------------------------------------------------------
 
@@ -220,6 +236,7 @@ class Telemetry:
             "last_record": dict(self.last_record),
             "straggler": dict(self.last_straggler),
             "overhead_s": round(self.overhead_s, 6),
+            "events": list(self.events),
             "compiles": {
                 tag: {
                     "flops_per_step_per_device": rec.get(

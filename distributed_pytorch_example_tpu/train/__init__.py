@@ -31,4 +31,7 @@ from distributed_pytorch_example_tpu.train.loop import (  # noqa: F401
     PreemptionInterrupt,
     Trainer,
 )
+from distributed_pytorch_example_tpu.robustness import (  # noqa: F401
+    BadStepBudgetExceeded,
+)
 from distributed_pytorch_example_tpu.train.generate import generate  # noqa: F401

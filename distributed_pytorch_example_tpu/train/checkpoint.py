@@ -48,21 +48,52 @@ TARGET layout), so gradient-sync mode flips across resume for free: a
 checkpoint written replicated restores into a ZeRO-1 run (moments get
 sharded over ``data`` on load) and vice versa (shards reassemble to full
 leaves, then replicate) — pinned by tests/test_zero1.py round-trips.
+
+Integrity, retention, and self-healing fallback (graft-armor, r10):
+
+- every artifact (gathered payload, shard file, manifest) is written
+  inside a CRC32 envelope (``robustness/integrity.py``), so a torn or
+  bit-flipped file fails LOUDLY at read time instead of deserializing
+  into a silently wrong pytree; pre-envelope files load unverified;
+- keep-last-K retention (``retain``): the gathered format keeps a
+  ``{path}.history/{seq}.ckpt`` trail (``latest`` is a hard link to the
+  newest entry); the sharded format's GC keeps the newest ``retain``
+  version dirs instead of exactly one. Mid-epoch sharded saves get a
+  UNIQUE ``{epoch}.{batch}`` version (zero-padded, so lexicographic
+  string order is still age order) — a crash mid-save can therefore
+  never destroy the previous intact version, which older code reused
+  and rmtree'd in-place;
+- ``load_checkpoint`` verifies integrity and, when the newest candidate
+  is torn/corrupt, walks back to the newest intact ancestor (sharded
+  version dirs, then gathered history), logging exactly what was
+  skipped and why. Only when NO candidate restores does it raise.
+- checkpoint writes go through chaos hooks (``robustness/chaos.py``)
+  so the fault matrix can inject transient ``OSError`` / mid-save
+  SIGKILL deterministically; without a plan installed the hooks are
+  no-ops.
 """
 
 from __future__ import annotations
 
 import os
+import re
 import shutil
 import threading
 import time
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
+from distributed_pytorch_example_tpu.robustness import chaos
+from distributed_pytorch_example_tpu.robustness.integrity import (
+    CheckpointCorruptError,
+    read_verified,
+    seal,
+)
+from distributed_pytorch_example_tpu.robustness.retry import with_retries
 from distributed_pytorch_example_tpu.runtime.logging import get_logger
 
 logger = get_logger(__name__)
@@ -74,6 +105,13 @@ LATEST_NAME = "latest_model.ckpt"
 # raw msgpack, which can never begin with this line)
 SHARDED_MAGIC = b"DPX-SHARDED-V1\n"
 SHARD_WAIT_TIMEOUT_S = 600.0
+
+# keep-last-K retention default: current + two ancestors. 1 = only the
+# live checkpoint (pre-r10 behavior); 0 disables the gathered history.
+DEFAULT_RETAIN = 3
+
+_VERSION_RE = re.compile(r"\d{8}(\.\d{8})?")
+_HISTORY_RE = re.compile(r"\d{8}\.ckpt")
 
 
 class AsyncSaver:
@@ -91,23 +129,54 @@ class AsyncSaver:
     collective all-gather, which must not race train-step collectives from
     another thread, so it backgrounds only at ``jax.process_count() == 1``
     and is synchronous multi-host.
+
+    Transient ``OSError``s (flaky shared filesystem) are retried with
+    bounded exponential backoff INSIDE the background thread
+    (``io_retries`` re-attempts); only a persistent failure is recorded.
+    A recorded failure surfaces at the next ``submit()``/``wait()``, and
+    the Trainer additionally polls ``check()`` once per train step so a
+    broken checkpoint path fails the run near the fault, not minutes
+    later at the end of ``fit``.
     """
 
-    def __init__(self):
+    def __init__(self, io_retries: int = 2, retry_base_delay: float = 0.1):
         self._pending: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        self._io_retries = io_retries
+        self._retry_base_delay = retry_base_delay
+        self.io_retries_used = 0  # healed transient failures (telemetry)
 
     def submit(self, fn: Callable[[], None]) -> None:
         self.wait()  # one in flight; also surfaces a prior failure
 
         def run():
             try:
-                fn()
-            except BaseException as e:  # re-raised on next wait()
+                with_retries(
+                    fn,
+                    attempts=self._io_retries + 1,
+                    base_delay=self._retry_base_delay,
+                    retry_on=(OSError,),
+                    describe="async checkpoint write",
+                    on_retry=self._on_retry,
+                )
+            except BaseException as e:  # re-raised on next check/wait
                 self._error = e
 
         self._pending = threading.Thread(target=run, daemon=True)
         self._pending.start()
+
+    def _on_retry(self, attempt: int, err: BaseException) -> None:
+        self.io_retries_used += 1
+
+    def check(self) -> None:
+        """Non-blocking: raise if a background save already FAILED.
+
+        Unlike ``wait()`` this never blocks on an in-flight save, so the
+        Trainer can call it every step at zero cost.
+        """
+        if self._pending is not None and self._pending.is_alive():
+            return
+        self.wait()
 
     def wait(self) -> None:
         if self._pending is not None:
@@ -143,14 +212,62 @@ def _gather_to_host(tree: Any) -> Any:
     return jax.device_get(jax.tree_util.tree_map(pre, tree))
 
 
-def _write_payload(path: str, host_state, epoch: int, loss: float, extra) -> None:
+def _next_history_seq(hist_dir: str) -> int:
+    seqs = [
+        int(n[:8]) for n in os.listdir(hist_dir) if _HISTORY_RE.fullmatch(n)
+    ]
+    return max(seqs, default=-1) + 1
+
+
+def _gathered_history_paths(path: str) -> List[str]:
+    """History entries newest-first (fallback candidates)."""
+    hist_dir = f"{path}.history"
+    if not os.path.isdir(hist_dir):
+        return []
+    names = sorted(
+        (n for n in os.listdir(hist_dir) if _HISTORY_RE.fullmatch(n)),
+        reverse=True,
+    )
+    return [os.path.join(hist_dir, n) for n in names]
+
+
+def _write_payload(
+    path: str, host_state, epoch: int, loss: float, extra,
+    retain: int = DEFAULT_RETAIN,
+) -> None:
     payload = {
         "epoch": epoch,
         "loss": float(loss),
         "state": serialization.to_state_dict(host_state),
         "extra": extra or {},
     }
-    _atomic_write(path, serialization.msgpack_serialize(payload))
+    blob = seal(serialization.msgpack_serialize(payload))
+    if retain > 0:
+        # retention trail: the sealed blob lands in {path}.history/ first,
+        # then `path` is committed as a hard link (copy on filesystems
+        # without links) — one physical write, K restorable generations
+        hist_dir = f"{path}.history"
+        os.makedirs(hist_dir, exist_ok=True)
+        hist_path = os.path.join(
+            hist_dir, f"{_next_history_seq(hist_dir):08d}.ckpt"
+        )
+        _atomic_write(hist_path, blob)
+        chaos.crash_point("gathered-save:pre-commit")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            if os.path.lexists(tmp):
+                os.remove(tmp)
+            os.link(hist_path, tmp)
+        except OSError:
+            shutil.copyfile(hist_path, tmp)
+        os.replace(tmp, path)
+        for stale in _gathered_history_paths(path)[retain:]:
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+    else:
+        _atomic_write(path, blob)
     # a job that switched from --checkpoint-format sharded to gathered
     # mid-life would otherwise strand {path}.shards forever: once the
     # gathered file is committed at `path`, the old shard root is
@@ -191,17 +308,31 @@ def _raw_leaves(tree: Any) -> Any:
 
 
 def _atomic_write(path: str, blob: bytes) -> None:
+    chaos.on_write(path)  # deterministic fault injection (no-op unarmed)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(blob)
     os.replace(tmp, path)
 
 
-def _version(epoch: int) -> str:
-    return f"{epoch:08d}"
+def _version(epoch: int, batch: Optional[int] = None) -> str:
+    """Checkpoint version name; zero-padded so string order is age order.
+
+    Mid-epoch saves (``batch`` from ``extra["batch_in_epoch"]``) get a
+    UNIQUE ``{epoch:08d}.{batch:08d}`` version instead of reusing the
+    epoch's name — a crashed mid-epoch save can then never clobber the
+    previous intact version (it targets a fresh dir). String order stays
+    age order: mid-epoch saves of epoch E (``0000000E.b``) sort after
+    the save that OPENED epoch E (the epoch-end commit of E-1, stamped
+    ``epoch+1`` = ``0000000E`` by the loop, a strict prefix and thus
+    smaller) and before the epoch-end commit of E (``0000000(E+1)``).
+    """
+    if not batch:
+        return f"{epoch:08d}"
+    return f"{epoch:08d}.{int(batch):08d}"
 
 
-def _begin_sharded_save(path: str, epoch: int) -> None:
+def _begin_sharded_save(path: str, version: str) -> None:
     """Main-thread prologue making the filesystem rendezvous sound.
 
     A step_dir surviving a crashed save (or an identical rerun) would let
@@ -213,25 +344,31 @@ def _begin_sharded_save(path: str, epoch: int) -> None:
     """
     from distributed_pytorch_example_tpu.runtime import distributed as dist
 
-    step_dir = os.path.join(f"{path}.shards", _version(epoch))
+    step_dir = os.path.join(f"{path}.shards", version)
     if jax.process_index() == 0 and os.path.isdir(step_dir):
         shutil.rmtree(step_dir, ignore_errors=True)
     if jax.process_count() > 1:
-        dist.barrier(f"ckpt-begin-{os.path.basename(path)}-{epoch}")
+        dist.barrier(f"ckpt-begin-{os.path.basename(path)}-{version}")
 
 
-def _save_sharded(path: str, state: Any, epoch: int, loss: float, extra) -> None:
+def _save_sharded(
+    path: str, state: Any, epoch: int, loss: float, extra,
+    retain: int = DEFAULT_RETAIN, version: Optional[str] = None,
+) -> None:
     """Collective-free sharded save; every process writes only its shards.
 
-    Layout: ``{path}.shards/{epoch:08d}/shard_{proc}.msgpack`` plus a
+    Layout: ``{path}.shards/{version}/shard_{proc}.msgpack`` plus a
     ``manifest.msgpack`` committed by process 0 once every shard file has
     landed (filesystem rendezvous on the shared checkpoint store — the
     reference's all-ranks-read contract presumes one, train.py:253,256).
     ``{path}`` itself becomes a small pointer file flipped atomically last,
-    so readers never observe a torn checkpoint.
+    so readers never observe a torn checkpoint. Every file is CRC-sealed;
+    versions strictly older than the newest ``retain`` are GC'd.
     """
     proc, nproc = jax.process_index(), jax.process_count()
-    step_dir = os.path.join(f"{path}.shards", _version(epoch))
+    if version is None:
+        version = _version(epoch, (extra or {}).get("batch_in_epoch"))
+    step_dir = os.path.join(f"{path}.shards", version)
     os.makedirs(step_dir, exist_ok=True)
 
     flat, _ = jax.tree_util.tree_flatten_with_path(_raw_leaves(state))
@@ -262,8 +399,12 @@ def _save_sharded(path: str, state: Any, epoch: int, loss: float, extra) -> None
         )
     _atomic_write(
         os.path.join(step_dir, f"shard_{proc:05d}.msgpack"),
-        serialization.msgpack_serialize(chunks),
+        seal(serialization.msgpack_serialize(chunks)),
     )
+    # torn-save injection site: this process's shard is on disk, the
+    # manifest/pointer commit has not happened — the window a preempted
+    # host dies in. The pointer still names the previous intact version.
+    chaos.crash_point("sharded-save:post-shards")
 
     if proc != 0:
         return
@@ -291,38 +432,77 @@ def _save_sharded(path: str, state: Any, epoch: int, loss: float, extra) -> None
     }
     _atomic_write(
         os.path.join(step_dir, "manifest.msgpack"),
-        serialization.msgpack_serialize(manifest),
+        seal(serialization.msgpack_serialize(manifest)),
     )
-    _atomic_write(path, SHARDED_MAGIC + _version(epoch).encode())
+    chaos.crash_point("sharded-save:post-manifest")
+    _atomic_write(path, SHARDED_MAGIC + version.encode())
     # GC: versions strictly OLDER than this commit are dead (per-process
-    # save ordering means every process finished writing them). Newer dirs
-    # may already hold in-flight shards from a save this slow process has
-    # not reached yet — zero-padded names make `<` the age comparison.
+    # save ordering means every process finished writing them) EXCEPT the
+    # newest retain-1, kept as fallback ancestors. Newer dirs may already
+    # hold in-flight shards from a save this slow process has not reached
+    # yet — zero-padded names make `<` the age comparison.
     base = f"{path}.shards"
-    for name in os.listdir(base):
-        if name < _version(epoch):
-            shutil.rmtree(os.path.join(base, name), ignore_errors=True)
+    older = sorted(
+        n for n in os.listdir(base)
+        if _VERSION_RE.fullmatch(n) and n < version
+    )
+    for name in older[: max(len(older) - max(retain - 1, 0), 0)]:
+        shutil.rmtree(os.path.join(base, name), ignore_errors=True)
     logger.info(
-        "Sharded checkpoint saved to %s (version %s)", path, _version(epoch)
+        "Sharded checkpoint saved to %s (version %s)", path, version
     )
 
 
-def _load_sharded(path: str, state_template: Any, shardings) -> Tuple[Any, int, dict]:
-    with open(path, "rb") as f:
-        version = f.read()[len(SHARDED_MAGIC):].decode().strip()
-    step_dir = os.path.join(f"{path}.shards", version)
-    with open(os.path.join(step_dir, "manifest.msgpack"), "rb") as f:
-        manifest = serialization.msgpack_restore(f.read())
+def _pointed_version_dir(path: str) -> Optional[str]:
+    """The version dir the pointer file names, or None if unparseable."""
+    try:
+        with open(path, "rb") as f:
+            version = f.read()[len(SHARDED_MAGIC):].decode(
+                "utf-8", errors="replace"
+            ).strip()
+    except OSError:
+        return None
+    if not _VERSION_RE.fullmatch(version):
+        logger.warning(
+            "Corrupt sharded pointer %s (version %r); falling back to the "
+            "version-dir scan", path, version[:40],
+        )
+        return None
+    return os.path.join(f"{path}.shards", version)
+
+
+def _sharded_version_dirs(path: str) -> List[str]:
+    """Committed-or-torn version dirs newest-first (fallback candidates)."""
+    base = f"{path}.shards"
+    if not os.path.isdir(base):
+        return []
+    names = sorted(
+        (n for n in os.listdir(base) if _VERSION_RE.fullmatch(n)),
+        reverse=True,
+    )
+    return [os.path.join(base, n) for n in names]
+
+
+def _load_sharded_version(
+    step_dir: str, state_template: Any, shardings
+) -> Tuple[Any, int, dict]:
+    """Restore one sharded version dir (CRC-verified manifest + shards)."""
+    manifest = serialization.msgpack_restore(
+        read_verified(os.path.join(step_dir, "manifest.msgpack"))
+    )
+    if not isinstance(manifest, dict) or "leaves" not in manifest:
+        raise CheckpointCorruptError(
+            f"{step_dir}: manifest is not a checkpoint manifest"
+        )
 
     buffers = {
         p: np.empty(tuple(m["shape"]), np.dtype(m["dtype"]))
         for p, m in manifest["leaves"].items()
     }
     for i in range(int(manifest["nproc"])):
-        with open(
-            os.path.join(step_dir, f"shard_{i:05d}.msgpack"), "rb"
-        ) as f:
-            chunks = serialization.msgpack_restore(f.read())
+        chunks = serialization.msgpack_restore(
+            read_verified(os.path.join(step_dir, f"shard_{i:05d}.msgpack"))
+        )
         for p, entries in chunks.items():
             for entry in entries:
                 data = np.asarray(entry["data"])
@@ -361,84 +541,21 @@ def _load_sharded(path: str, state_template: Any, shardings) -> Tuple[Any, int, 
         )
     state = jax.tree_util.tree_unflatten(treedef, restored)
     logger.info(
-        "Sharded checkpoint loaded from %s, epoch %s", path, manifest["epoch"]
+        "Sharded checkpoint loaded from %s, epoch %s",
+        step_dir, manifest["epoch"],
     )
     return state, int(manifest["epoch"]), dict(manifest.get("extra", {}))
 
 
-def _is_sharded(path: str) -> bool:
-    try:
-        with open(path, "rb") as f:
-            return f.read(len(SHARDED_MAGIC)) == SHARDED_MAGIC
-    except OSError:
-        return False
-
-
-def save_checkpoint(
-    path: str,
-    state: Any,
-    epoch: int,
-    loss: float,
-    extra: Optional[dict] = None,
-    saver: Optional[AsyncSaver] = None,
-    sharded: bool = False,
-) -> None:
-    """Write a checkpoint; see module docstring for the two formats.
-
-    Async (``saver``) rules: the gathered format needs a collective
-    all-gather, so it backgrounds only at process_count == 1; the sharded
-    format is collective-free and backgrounds at ANY process count.
-    """
-    write = (
-        (lambda snap: _save_sharded(path, snap, epoch, loss, extra))
-        if sharded
-        else (
-            lambda snap: _write_payload(
-                path, _gather_to_host(snap), epoch, loss, extra
-            )
-        )
-    )
-    if sharded:
-        # a still-draining PREVIOUS async write may target the same
-        # version dir (mid-epoch saves reuse _version(epoch)); it must
-        # land before the cleanup rmtree below, or the old writer crashes
-        # mid-write / stale shards leak into the new manifest
-        if saver is not None:
-            saver.wait()
-        _begin_sharded_save(path, epoch)  # main thread: cleanup + barrier
-    if saver is not None and (sharded or jax.process_count() == 1):
-        # HBM-side copy: later donated train steps cannot invalidate it
-        snap = jax.tree_util.tree_map(
-            lambda x: x.copy() if isinstance(x, jax.Array) else x, state
-        )
-        saver.submit(lambda: write(snap))
-        return
-    if sharded:
-        _save_sharded(path, state, epoch, loss, extra)
-        return
-    host_state = _gather_to_host(state)
-    if jax.process_index() != 0:
-        return
-    _write_payload(path, host_state, epoch, loss, extra)
-
-
-def load_checkpoint(
-    path: str,
-    state_template: Any,
-    shardings: Optional[Any] = None,
+def _load_gathered_file(
+    path: str, state_template: Any, shardings
 ) -> Tuple[Any, int, dict]:
-    """Restore (state, epoch, extra) onto devices, re-sharded per template.
-
-    Every process reads the same file (reference train.py:256: resume runs on
-    ALL ranks before the start barrier). Device placement comes from
-    ``shardings`` when given, else from the template's live shardings.
-    The format (gathered file vs sharded pointer) is auto-detected, so a
-    job can resume from either regardless of its own save format.
-    """
-    if _is_sharded(path):
-        return _load_sharded(path, state_template, shardings)
-    with open(path, "rb") as f:
-        payload = serialization.msgpack_restore(f.read())
+    """Restore one gathered checkpoint file (CRC-verified)."""
+    payload = serialization.msgpack_restore(read_verified(path))
+    if not isinstance(payload, dict) or "state" not in payload:
+        raise CheckpointCorruptError(
+            f"{path}: not a gathered checkpoint payload"
+        )
     state = serialization.from_state_dict(state_template, payload["state"])
 
     if shardings is None:
@@ -457,3 +574,182 @@ def load_checkpoint(
     state = jax.tree_util.tree_map(restore_leaf, state_template, state, shardings)
     logger.info("Checkpoint loaded from %s, epoch %s", path, payload["epoch"])
     return state, int(payload["epoch"]), dict(payload.get("extra", {}))
+
+
+def _is_sharded(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            return f.read(len(SHARDED_MAGIC)) == SHARDED_MAGIC
+    except OSError:
+        return False
+
+
+def save_checkpoint(
+    path: str,
+    state: Any,
+    epoch: int,
+    loss: float,
+    extra: Optional[dict] = None,
+    saver: Optional[AsyncSaver] = None,
+    sharded: bool = False,
+    retain: int = DEFAULT_RETAIN,
+) -> None:
+    """Write a checkpoint; see module docstring for the two formats.
+
+    Async (``saver``) rules: the gathered format needs a collective
+    all-gather, so it backgrounds only at process_count == 1; the sharded
+    format is collective-free and backgrounds at ANY process count.
+    ``retain`` keeps the newest K generations restorable (fallback
+    ancestors for ``load_checkpoint``); 1 reproduces the pre-r10
+    only-the-live-checkpoint behavior.
+    """
+    version = _version(epoch, (extra or {}).get("batch_in_epoch"))
+    write = (
+        (lambda snap: _save_sharded(
+            path, snap, epoch, loss, extra, retain=retain, version=version
+        ))
+        if sharded
+        else (
+            lambda snap: _write_payload(
+                path, _gather_to_host(snap), epoch, loss, extra,
+                retain=retain,
+            )
+        )
+    )
+    if sharded:
+        # a still-draining PREVIOUS async write may target the same
+        # version dir (a crash-rerun repeats a version name); it must
+        # land before the cleanup rmtree below, or the old writer crashes
+        # mid-write / stale shards leak into the new manifest
+        if saver is not None:
+            saver.wait()
+        _begin_sharded_save(path, version)  # main thread: cleanup + barrier
+    if saver is not None and (sharded or jax.process_count() == 1):
+        # HBM-side copy: later donated train steps cannot invalidate it
+        snap = jax.tree_util.tree_map(
+            lambda x: x.copy() if isinstance(x, jax.Array) else x, state
+        )
+        saver.submit(lambda: write(snap))
+        return
+    if sharded:
+        _save_sharded(
+            path, state, epoch, loss, extra, retain=retain, version=version
+        )
+        return
+    host_state = _gather_to_host(state)
+    if jax.process_index() != 0:
+        return
+    _write_payload(path, host_state, epoch, loss, extra, retain=retain)
+
+
+def load_checkpoint(
+    path: str,
+    state_template: Any,
+    shardings: Optional[Any] = None,
+    fallback: bool = True,
+    on_event: Optional[Callable[..., None]] = None,
+) -> Tuple[Any, int, dict]:
+    """Restore (state, epoch, extra) onto devices, re-sharded per template.
+
+    Every process reads the same file (reference train.py:256: resume runs on
+    ALL ranks before the start barrier). Device placement comes from
+    ``shardings`` when given, else from the template's live shardings.
+    The format (gathered file vs sharded pointer) is auto-detected, so a
+    job can resume from either regardless of its own save format.
+
+    Self-healing (``fallback=True``): every candidate is CRC-verified;
+    when the newest is torn/corrupt/unreadable the loader walks back to
+    the newest intact ancestor — the pointed sharded version first, then
+    older version dirs, then gathered history entries — logging exactly
+    what was skipped and why, and firing
+    ``on_event("checkpoint_fallback", restored=..., skipped=[...])`` so
+    the Trainer can count the recovery. Raises
+    :class:`CheckpointCorruptError` listing every attempt only when no
+    candidate restores. ``fallback=False`` restores the strict pre-r10
+    behavior (first failure propagates).
+    """
+    candidates: List[Tuple[str, Callable[[], Tuple[Any, int, dict]]]] = []
+
+    def add_sharded_candidates(primary_first: bool) -> None:
+        pointed = _pointed_version_dir(path) if primary_first else None
+        if pointed is not None:
+            candidates.append((
+                pointed,
+                lambda d=pointed: _load_sharded_version(
+                    d, state_template, shardings
+                ),
+            ))
+        for d in _sharded_version_dirs(path):
+            if pointed is not None and os.path.basename(
+                d
+            ) == os.path.basename(pointed):
+                continue
+            candidates.append((
+                d,
+                lambda d=d: _load_sharded_version(
+                    d, state_template, shardings
+                ),
+            ))
+
+    if _is_sharded(path):
+        add_sharded_candidates(primary_first=True)
+    else:
+        candidates.append((
+            path,
+            lambda: _load_gathered_file(path, state_template, shardings),
+        ))
+        for p in _gathered_history_paths(path):
+            try:
+                if os.path.samefile(p, path):
+                    continue  # `path` hard-links the newest history entry
+            except OSError:
+                pass
+            candidates.append((
+                p,
+                lambda p=p: _load_gathered_file(p, state_template, shardings),
+            ))
+        # a bit-flipped pointer file no longer matches SHARDED_MAGIC and
+        # parses as (corrupt) gathered; intact version dirs still restore
+        add_sharded_candidates(primary_first=False)
+
+    if not fallback:
+        candidates = candidates[:1]
+    if not candidates:
+        raise FileNotFoundError(f"no checkpoint candidates at {path}")
+
+    skipped: List[Tuple[str, str]] = []
+    for desc, thunk in candidates:
+        try:
+            state, epoch, extra = thunk()
+        except Exception as err:
+            if not fallback:
+                raise
+            reason = f"{type(err).__name__}: {err}"
+            skipped.append((desc, reason))
+            logger.warning(
+                "Checkpoint candidate %s unusable (%s); trying the "
+                "next-newest ancestor", desc, reason,
+            )
+            continue
+        if skipped:
+            logger.warning(
+                "Checkpoint fallback: restored %s (epoch %d) after "
+                "skipping %d corrupt/torn candidate(s): %s",
+                desc, epoch, len(skipped),
+                "; ".join(f"{d} ({r})" for d, r in skipped),
+            )
+            if on_event is not None:
+                on_event(
+                    "checkpoint_fallback",
+                    restored=desc,
+                    epoch=epoch,
+                    skipped=[
+                        {"candidate": d, "reason": r} for d, r in skipped
+                    ],
+                )
+        return state, epoch, extra
+    raise CheckpointCorruptError(
+        f"no intact checkpoint at {path}: all {len(skipped)} candidate(s) "
+        "failed — "
+        + "; ".join(f"{d} ({r})" for d, r in skipped)
+    )

@@ -31,6 +31,10 @@ import jax.numpy as jnp
 import optax
 
 from distributed_pytorch_example_tpu.parallel.api import Partitioner
+from distributed_pytorch_example_tpu.robustness import (
+    BadStepBudgetExceeded,
+    chaos,
+)
 from distributed_pytorch_example_tpu.runtime import distributed as dist
 from distributed_pytorch_example_tpu.runtime.logging import get_logger
 from distributed_pytorch_example_tpu.train import checkpoint as ckpt_lib
@@ -69,13 +73,19 @@ def _spanned_batches(iterator, scope: Optional[Telemetry]):
 
 
 class PreemptionInterrupt(BaseException):
-    """Raised inside ``fit`` after a SIGTERM-triggered checkpoint landed.
+    """Raised inside ``fit`` after a signal-triggered checkpoint landed.
 
-    BaseException so blanket ``except Exception`` recovery logic cannot
-    swallow a teardown. The CLI (train.py) converts it to ``exit(143)`` —
-    the rc the launcher treats as orchestrator teardown, NOT restarted
-    (launch/entrypoint.sh:133-141).
+    SIGTERM (orchestrator preemption) and SIGINT (Ctrl-C on a dev box)
+    both unwind through here once the in-flight step has checkpointed;
+    ``exit_code`` carries the conventional rc for the CLI — 143 for TERM
+    (the rc the launcher treats as orchestrator teardown, NOT restarted,
+    launch/entrypoint.sh:133-141) and 130 for INT. BaseException so
+    blanket ``except Exception`` recovery logic cannot swallow a teardown.
     """
+
+    def __init__(self, exit_code: int = 143):
+        super().__init__(exit_code)
+        self.exit_code = exit_code
 
 
 class Trainer:
@@ -98,6 +108,9 @@ class Trainer:
         grad_accum_steps: int = 1,
         telemetry: Union[bool, TelemetryConfig] = True,
         telemetry_every: int = 0,
+        max_bad_steps: int = 8,
+        skip_nonfinite: bool = True,
+        checkpoint_retain: int = ckpt_lib.DEFAULT_RETAIN,
     ):
         self.model = model
         self.task = task
@@ -115,9 +128,22 @@ class Trainer:
         # of the optimizer-level optax.MultiSteps every_k (which pays the
         # gradient sync on every micro-step)
         self.grad_accum_steps = grad_accum_steps
+        # graft-armor bad-step auto-recovery: the step predicates the
+        # update out device-side when grads go nonfinite (train/step.py);
+        # the host counts those skips against max_bad_steps at log
+        # boundaries — exceed ⇒ one rollback to the last good checkpoint,
+        # exceed again ⇒ BadStepBudgetExceeded. 0 disables the budget
+        # (skips are unlimited); skip_nonfinite=False removes the
+        # predication entirely (pre-r10 step program).
+        self.max_bad_steps = max_bad_steps
+        self.skip_nonfinite = skip_nonfinite
+        # keep-last-K checkpoint generations (fallback ancestors for
+        # corrupt-latest auto-recovery, train/checkpoint.py)
+        self.checkpoint_retain = checkpoint_retain
         self.train_step = build_train_step(
             model, task, optimizer,
             partitioner=partitioner, grad_accum_steps=grad_accum_steps,
+            skip_nonfinite=skip_nonfinite,
         )
         self.eval_step = build_eval_step(model, task)
         self.state: Optional[TrainState] = None
@@ -159,6 +185,15 @@ class Trainer:
         self.save_every_steps = save_every_steps
         self._best_accuracy = 0.0
         self._preempt_requested = False
+        self._preempt_rc = 143
+        # recovery observability (reset per fit): how often each
+        # graft-armor surface fired
+        self.recovery: Dict[str, int] = {
+            "bad_steps": 0, "rollbacks": 0, "checkpoint_fallbacks": 0,
+        }
+        self._pending_bad: List[Any] = []  # device flags, drained at bounds
+        self._bad_since_recovery = 0
+        self._rolled_back = False
 
     def _sharded_ckpt(self) -> bool:
         """auto: sharded at multi-host scale (collective-free async saves,
@@ -316,6 +351,10 @@ class Trainer:
         ):
             if self._profiler is not None:
                 self._profiler.step(self._global_step)
+            # deterministic fault injection (no-op without a chaos plan):
+            # the poisoned batch keeps its sharding, so the same compiled
+            # step executes it — the bad-step cond handles the rest
+            batch = chaos.corrupt_batch(batch, self._global_step)
             with self._mesh_ctx():
                 step_key, step_fn = self._train_executable(batch)
                 with _span(scope, "step"):
@@ -325,6 +364,13 @@ class Trainer:
                     )
             self._global_step += 1
             acc.append(metrics)
+            if "bad_step" in metrics:
+                # device scalar, no sync — summed against the budget at
+                # the log boundary below
+                self._pending_bad.append(metrics["bad_step"])
+            # a FAILED background save surfaces here, within one step of
+            # the fault, instead of minutes later at fit's final wait()
+            self._saver.check()
             if scope is not None:
                 # rate-limited clock tick + (at boundaries) the one-fetch
                 # health check, straggler exchange, and per-N-step record.
@@ -342,6 +388,11 @@ class Trainer:
                     num_batches,
                     float(metrics["loss"]),
                 )
+            if batch_idx % self.log_every == 0:
+                # EVERY process, same cadence (pure function of the batch
+                # index): budget decisions — rollback, hard-fail — must be
+                # taken identically on all hosts
+                self._drain_bad_steps()
             if (
                 self.save_every_steps
                 and self.checkpoint_dir
@@ -378,8 +429,91 @@ class Trainer:
                         "checkpoint (--save-every-steps) is the resume "
                         "point"
                     )
-                raise PreemptionInterrupt()
+                raise PreemptionInterrupt(self._preempt_rc)
+        self._drain_bad_steps()  # epoch tail shorter than log_every
         return acc.result()
+
+    # -- bad-step budget (graft-armor) ------------------------------------
+
+    def _record_event(self, kind: str, **fields) -> None:
+        """Recovery-event sink: counts per-surface firings and forwards to
+        graft-scope as a first-class record (telemetry/scope.py)."""
+        if kind == "checkpoint_fallback":
+            self.recovery["checkpoint_fallbacks"] += 1
+        if self.scope is not None:
+            self.scope.record_event(kind, **fields)
+
+    def _drain_bad_steps(self) -> None:
+        """Sum the bad-step flags accumulated since the last boundary (ONE
+        host fetch of tiny scalars, log cadence) and enforce the budget:
+        exceed ⇒ one rollback to the last good checkpoint, exceed again ⇒
+        :class:`BadStepBudgetExceeded`. The flags are global reductions —
+        identical on every shard — and the cadence is a pure function of
+        the batch index, so every process takes the same decision."""
+        if not self._pending_bad:
+            return
+        flags = jax.device_get(self._pending_bad)
+        self._pending_bad = []
+        new = int(round(sum(float(f) for f in flags)))
+        if new == 0:
+            return
+        self.recovery["bad_steps"] += new
+        self._bad_since_recovery += new
+        logger.warning(
+            "graft-armor: %d nonfinite step(s) skipped device-side "
+            "(%d since last recovery, budget %s)",
+            new, self._bad_since_recovery,
+            self.max_bad_steps or "unlimited",
+        )
+        self._record_event(
+            "bad_step_skip", step=self._global_step, new_skips=new,
+            since_recovery=self._bad_since_recovery,
+            budget=self.max_bad_steps,
+        )
+        if self.max_bad_steps and (
+            self._bad_since_recovery > self.max_bad_steps
+        ):
+            self._rollback_or_fail()
+
+    def _rollback_or_fail(self) -> None:
+        """One-shot rollback to `latest`, then hard-fail on re-exhaustion.
+
+        The skipped updates never touched params (predication), so the
+        rollback discards only the GOOD updates since the checkpoint —
+        the price of retrying a fault that by now looks persistent. A
+        second exhaustion (or no checkpoint at all) means retrying cannot
+        help: surface the fault instead of burning accelerator time.
+        """
+        latest = (
+            os.path.join(self.checkpoint_dir, ckpt_lib.LATEST_NAME)
+            if self.checkpoint_dir else None
+        )
+        if self._rolled_back or not latest or not os.path.exists(latest):
+            raise BadStepBudgetExceeded(
+                f"{self.recovery['bad_steps']} nonfinite step(s) skipped; "
+                f"budget max_bad_steps={self.max_bad_steps} exhausted "
+                + ("again after a rollback" if self._rolled_back
+                   else "with no checkpoint to roll back to")
+                + " — persistent fault (diverged optimization, bad data "
+                "shard, or a real numerics bug)"
+            )
+        self._saver.wait()  # an in-flight save must land before the read
+        self.state, epoch, _extra = ckpt_lib.load_checkpoint(
+            latest, self.state, self.state_shardings,
+            on_event=self._record_event,
+        )
+        self._rolled_back = True
+        self._bad_since_recovery = 0
+        self.recovery["rollbacks"] += 1
+        logger.warning(
+            "graft-armor: bad-step budget exceeded — rolled back to %s "
+            "(epoch %d); the next budget exhaustion hard-fails",
+            latest, epoch,
+        )
+        self._record_event(
+            "rollback", step=self._global_step, checkpoint=latest,
+            epoch=epoch,
+        )
 
     def _save_mid_epoch(self, epoch, batch_idx, metrics):
         """Write `latest` stamped with the CURRENT epoch + loader cursor
@@ -396,6 +530,7 @@ class Trainer:
                 },
                 saver=self._saver,
                 sharded=self._sharded_ckpt(),
+                retain=self.checkpoint_retain,
             )
 
     def validate(self, loader) -> Dict[str, float]:
@@ -471,9 +606,19 @@ class Trainer:
         start_epoch = 0
         start_batch = 0
         best_accuracy = 0.0
+        self.recovery = {
+            "bad_steps": 0, "rollbacks": 0, "checkpoint_fallbacks": 0,
+        }
+        self._pending_bad = []
+        self._bad_since_recovery = 0
+        self._rolled_back = False
         if resuming:
+            # fallback-enabled: a torn/corrupt `latest` walks back to the
+            # newest intact ancestor instead of aborting the run; the
+            # skip reasons land in the log and the recovery counters
             self.state, saved_epoch, extra = ckpt_lib.load_checkpoint(
-                resume, self.state, self.state_shardings
+                resume, self.state, self.state_shardings,
+                on_event=self._record_event,
             )
             start_epoch = saved_epoch
             best_accuracy = float(extra.get("best_accuracy", 0.0))
@@ -494,22 +639,30 @@ class Trainer:
         self._global_step = int(jax.device_get(self.state.step))
         if self._profiler is not None:
             self._profiler.rebase(self._global_step)
-        # graceful preemption: SIGTERM finishes the in-flight step, writes
-        # `latest` with the loader cursor, and unwinds as
-        # PreemptionInterrupt (the launcher's no-restart teardown rc is
-        # preserved by the CLI exiting 143). Handler installation needs the
-        # main thread (tests drive fit() from worker threads: skip there).
+        # graceful preemption: SIGTERM (orchestrator) and SIGINT (Ctrl-C
+        # on a dev box) finish the in-flight step, write `latest` with the
+        # loader cursor, and unwind as PreemptionInterrupt (the CLI exits
+        # 143 / 130 respectively). Handler installation needs the main
+        # thread (tests drive fit() from worker threads: skip there).
         self._preempt_requested = False
-        prev_term = None
+        self._preempt_rc = 143
+        prev_term = prev_int = None
         if threading.current_thread() is threading.main_thread():
-            def _on_term(signum, frame):
+            def _on_signal(signum, frame):
                 self._preempt_requested = True
+                self._preempt_rc = 130 if signum == signal.SIGINT else 143
+                if signum == signal.SIGINT:
+                    # a second Ctrl-C must still be able to kill a wedged
+                    # run: restore the prior disposition after the first
+                    signal.signal(signal.SIGINT, prev_int)
                 logger.info(
-                    "SIGTERM received: checkpointing after the in-flight "
-                    "step, then exiting"
+                    "%s received: checkpointing after the in-flight "
+                    "step, then exiting %d",
+                    signal.Signals(signum).name, self._preempt_rc,
                 )
 
-            prev_term = signal.signal(signal.SIGTERM, _on_term)
+            prev_term = signal.signal(signal.SIGTERM, _on_signal)
+            prev_int = signal.signal(signal.SIGINT, _on_signal)
         try:
             history, best_accuracy = self._epoch_loop(
                 train_loader, val_loader, start_epoch, epochs,
@@ -518,6 +671,8 @@ class Trainer:
         finally:
             if prev_term is not None:
                 signal.signal(signal.SIGTERM, prev_term)
+            if prev_int is not None:
+                signal.signal(signal.SIGINT, prev_int)
             # an exception mid-window must not leave a dangling active
             # jax trace, an unflushed metrics file, or a half-queued save
             if self.scope is not None:
@@ -634,6 +789,7 @@ class Trainer:
                             extra,
                             saver=self._saver,
                             sharded=self._sharded_ckpt(),
+                            retain=self.checkpoint_retain,
                         )
                     ckpt_lib.save_checkpoint(
                         os.path.join(
@@ -645,6 +801,7 @@ class Trainer:
                         extra,
                         saver=self._saver,
                         sharded=self._sharded_ckpt(),
+                        retain=self.checkpoint_retain,
                     )
             dist.barrier("epoch-end")
         return history, self._best_accuracy

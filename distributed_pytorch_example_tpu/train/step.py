@@ -135,6 +135,7 @@ def build_train_step(
     partitioner: Optional[Partitioner] = None,
     grad_accum_steps: int = 1,
     sentinels: bool = True,
+    skip_nonfinite: bool = True,
 ):
     """One compiled optimization step: (state, batch) -> (state, metrics).
 
@@ -150,6 +151,16 @@ def build_train_step(
     updated params (a few fused reductions; under sharded configs their
     partial-sum all-reduces are part of the committed comm budgets) and
     fetched only at log boundaries, so health monitoring adds no host syncs.
+
+    ``skip_nonfinite`` (default on) is graft-armor's bad-step predication:
+    a ``lax.cond`` on the in-step nonfinite-grad count keeps the params /
+    optimizer state / model state of a poisoned step UNCHANGED, device-side
+    — no host sync, no recompile, the same single executable runs clean and
+    poisoned steps. ``step`` and the rng still advance (the trajectory
+    moves past the bad batch), and ``metrics["bad_step"]`` records the
+    skip so the Trainer can count it against ``max_bad_steps``. The
+    predicate reuses the sentinel reduction (XLA CSE), so the cond adds
+    compute only, no collectives — the comm budgets are unchanged.
     """
     if grad_accum_steps < 1:
         raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
@@ -310,10 +321,35 @@ def build_train_step(
                 state.params, state.model_state, batch, step_rng
             )
 
-        updates, new_opt_state = optimizer.update(
-            grads, state.opt_state, state.params
-        )
-        new_params = optax.apply_updates(state.params, updates)
+        if skip_nonfinite:
+            from distributed_pytorch_example_tpu.telemetry.sentinels import (
+                nonfinite_count,
+            )
+
+            # graft-armor bad-step predication: a poisoned batch (NaN/Inf
+            # anywhere in the synced grads) must not touch params, moments,
+            # or model state. The predicate is a global reduction over the
+            # post-sync grads — identical on every shard, so every process
+            # takes the same branch; XLA CSEs it with the sentinel below.
+            update_ok = nonfinite_count(grads) == 0
+
+            def apply_update(grads, opt_state, params, ms, _old_ms):
+                updates, opt2 = optimizer.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), opt2, ms
+
+            def skip_update(_grads, opt_state, params, _ms, old_ms):
+                return params, opt_state, old_ms
+
+            new_params, new_opt_state, new_ms = jax.lax.cond(
+                update_ok, apply_update, skip_update,
+                grads, state.opt_state, state.params, new_ms,
+                state.model_state,
+            )
+        else:
+            updates, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
         if zero1:
             # pin the ZeRO-1 layout: the sharded-gradient update must KEEP
             # the moments sharded (a propagation choice to replicate them
@@ -343,6 +379,13 @@ def build_train_step(
             # post-sync grads + updated params: global values on every
             # shard, async device scalars until a log-boundary fetch
             metrics = {**metrics, **sentinel_metrics(grads, new_params)}
+        if skip_nonfinite:
+            # 1.0 exactly on skipped steps; summed host-side against the
+            # max_bad_steps budget at log boundaries (train/loop.py)
+            metrics = {
+                **metrics,
+                "bad_step": 1.0 - update_ok.astype(jnp.float32),
+            }
         return new_state, metrics
 
     return jax.jit(train_step, donate_argnums=0)

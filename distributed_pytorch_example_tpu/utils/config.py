@@ -161,4 +161,20 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         help="global-norm gradient clipping threshold")
     parser.add_argument("--grad-accum", type=int, default=1,
                         help="accumulate k micro-steps per optimizer step")
+    parser.add_argument("--max-bad-steps", type=int, default=8,
+                        help="nonfinite steps skipped device-side before "
+                        "rolling back to the last good checkpoint (a second "
+                        "exhaustion hard-fails); 0 disables the budget")
+    parser.add_argument("--no-skip-nonfinite", action="store_true",
+                        help="disable graft-armor update predication: apply "
+                        "the optimizer update even when gradients are "
+                        "nonfinite (pre-r10 behavior)")
+    parser.add_argument("--checkpoint-retain", type=int, default=3,
+                        help="intact checkpoint generations kept per root "
+                        "(keep-last-K; older ones are fallback candidates "
+                        "when `latest` is torn or corrupt)")
+    parser.add_argument("--chaos", type=str, default=None,
+                        help="deterministic fault injection: a preset name "
+                        "(nan-step|io-flake) or a ChaosPlan JSON object; "
+                        "equivalent to setting $DPX_CHAOS")
     return parser

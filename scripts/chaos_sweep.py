@@ -1,0 +1,512 @@
+#!/usr/bin/env python3
+"""graft-armor chaos sweep: seeded fault matrix, one JSON line each.
+
+Drives a real ``Trainer.fit`` (tiny SimpleNet on the fake 8-device CPU
+mesh) through every fault class the robustness layer claims to survive
+and prints ONE JSON summary line per scenario — ``ok``, the recovery
+``action`` the framework took, and the evidence fields — so a CI log
+shows exactly which guarantee broke. Exit code 0 iff every scenario
+recovered as contracted.
+
+Scenarios (``--fast`` runs the starred subset; the rest ride the full
+matrix — tier-1 runs the fast subset via tests/test_chaos.py, the full
+matrix runs under ``-m slow``):
+
+- ``nan-skip`` *        NaN batch mid-run: update predicated out
+                        device-side, trajectory deterministic (the run is
+                        repeated and must match bit-for-bit).
+- ``inf-skip``          Same contract for an Inf batch.
+- ``budget-rollback``   Persistent NaN: bounded skips, ONE rollback to
+                        the last good checkpoint, then a hard fail.
+- ``corrupt-latest`` *  Bit-flipped `latest`: load falls back to the
+                        newest intact ancestor, no operator action.
+- ``truncate-shard``    Torn shard file: sharded load falls back to the
+                        previous intact version dir.
+- ``io-flake`` *        Transient OSError on checkpoint writes: the
+                        async saver retries with backoff and the file
+                        lands.
+- ``rendezvous-flake`` * Coordinator not up yet: bounded retry with
+                        exponential backoff on initialize().
+- ``torn-save-kill``    Subprocess SIGKILLed between shard writes and
+                        the manifest/pointer flip; the resume run lands
+                        on the previous intact checkpoint.
+- ``sigint``            Subprocess interrupted: checkpoint after the
+                        in-flight step, exit 130.
+
+Usage:
+  python scripts/chaos_sweep.py [--fast] [--scenarios a,b,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+FAST = ("nan-skip", "corrupt-latest", "io-flake", "rendezvous-flake")
+SLOW = (
+    "inf-skip", "budget-rollback", "truncate-shard", "torn-save-kill",
+    "sigint",
+)
+ALL = FAST + SLOW
+
+
+def _force_cpu_mesh(n: int = 8) -> None:
+    """Fake n-device CPU mesh (same knobs as tests/conftest.py); must run
+    before jax initializes a backend."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _child_env(chaos_json: str = "") -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flag).strip()
+    if chaos_json:
+        env["DPX_CHAOS"] = chaos_json
+    else:
+        env.pop("DPX_CHAOS", None)
+    return env
+
+
+def _make_trainer(ckpt_dir=None, **kw):
+    import optax
+
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.models import SimpleNet
+
+    return dpx.train.Trainer(
+        SimpleNet(input_size=16, hidden_size=32, num_classes=4),
+        dpx.train.ClassificationTask(),
+        optax.adam(1e-2),
+        partitioner=dpx.parallel.data_parallel(kw.pop("mesh")),
+        checkpoint_dir=ckpt_dir,
+        log_every=kw.pop("log_every", 2),
+        **kw,
+    )
+
+
+def _dataset(n=256, seed=0):
+    import numpy as np
+
+    from distributed_pytorch_example_tpu.data.synthetic import _ArrayDataset
+
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 16)).astype(np.float32)
+    w = rng.standard_normal((16, 4)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return _ArrayDataset({"x": x, "y": y})
+
+
+def _param_digest(state) -> str:
+    import hashlib
+
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _fit_with_poison(kind: str, mesh):
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.robustness import chaos
+
+    chaos.install(chaos.ChaosPlan(faults=[chaos.Fault(kind, step=2)]))
+    try:
+        trainer = _make_trainer(mesh=mesh)
+        loader = dpx.data.DeviceLoader(_dataset(), 64, mesh=mesh, seed=0)
+        history = trainer.fit(loader, epochs=2)
+    finally:
+        chaos.uninstall()
+    return trainer, history
+
+
+def scenario_poison_skip(kind: str) -> dict:
+    """nan-skip / inf-skip: skipped update, deterministic trajectory."""
+    import math
+
+    import distributed_pytorch_example_tpu as dpx
+
+    mesh = dpx.runtime.make_mesh()
+    t1, h1 = _fit_with_poison(kind, mesh)
+    detail = {
+        "bad_steps": t1.recovery["bad_steps"],
+        "rollbacks": t1.recovery["rollbacks"],
+        "final_loss_finite": math.isfinite(h1[-1]["train_loss"]),
+    }
+    ok = detail["bad_steps"] >= 1 and detail["final_loss_finite"]
+    if kind == "nan-batch":
+        # the determinism contract: same plan, same seed ⇒ bit-identical
+        # params (the skip is part of the compiled program, not a host race)
+        t2, _ = _fit_with_poison(kind, mesh)
+        detail["deterministic"] = _param_digest(t1.state) == _param_digest(
+            t2.state
+        )
+        ok = ok and detail["deterministic"]
+    return {"ok": ok, "action": "update-predicated-out", **detail}
+
+
+def scenario_budget_rollback() -> dict:
+    """Persistent NaN: skips bounded, one rollback, then hard fail."""
+    import tempfile
+
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.robustness import (
+        BadStepBudgetExceeded,
+        chaos,
+    )
+
+    mesh = dpx.runtime.make_mesh()
+    chaos.install(chaos.ChaosPlan(
+        faults=[chaos.Fault("nan-batch", step=2, count=10_000)]
+    ))
+    hard_failed = False
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            trainer = _make_trainer(
+                ckpt_dir=td, mesh=mesh, log_every=1, max_bad_steps=1,
+                save_every_steps=1,
+            )
+            loader = dpx.data.DeviceLoader(
+                _dataset(), 64, mesh=mesh, seed=0
+            )
+            try:
+                trainer.fit(loader, epochs=3)
+            except BadStepBudgetExceeded:
+                hard_failed = True
+    finally:
+        chaos.uninstall()
+    detail = {
+        "bad_steps": trainer.recovery["bad_steps"],
+        "rollbacks": trainer.recovery["rollbacks"],
+        "hard_failed": hard_failed,
+    }
+    return {
+        "ok": detail["rollbacks"] == 1 and hard_failed,
+        "action": "rollback-then-hard-fail",
+        **detail,
+    }
+
+
+def scenario_corrupt_latest() -> dict:
+    """Bit-flipped gathered `latest`: fallback to newest intact ancestor."""
+    import tempfile
+
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.robustness import chaos
+    from distributed_pytorch_example_tpu.train import checkpoint as ckpt_lib
+
+    mesh = dpx.runtime.make_mesh()
+    events = []
+    with tempfile.TemporaryDirectory() as td:
+        trainer = _make_trainer(ckpt_dir=td, mesh=mesh)
+        loader = dpx.data.DeviceLoader(_dataset(), 64, mesh=mesh, seed=0)
+        trainer.fit(loader, epochs=2)
+        latest = os.path.join(td, ckpt_lib.LATEST_NAME)
+        chaos.corrupt_file(latest, mode="bitflip", seed=0)
+        _state, epoch, _extra = ckpt_lib.load_checkpoint(
+            latest, trainer.state, trainer.state_shardings,
+            on_event=lambda kind, **f: events.append({"event": kind, **f}),
+        )
+    fallbacks = [e for e in events if e["event"] == "checkpoint_fallback"]
+    return {
+        "ok": len(fallbacks) == 1 and epoch >= 1,
+        "action": "fallback-to-intact-ancestor",
+        "restored_epoch": int(epoch),
+        "skipped": fallbacks[0]["skipped"] if fallbacks else [],
+    }
+
+
+def scenario_truncate_shard() -> dict:
+    """Truncated shard in the pointed version: fallback to older version."""
+    import glob
+    import tempfile
+
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.robustness import chaos
+    from distributed_pytorch_example_tpu.train import checkpoint as ckpt_lib
+
+    mesh = dpx.runtime.make_mesh()
+    events = []
+    with tempfile.TemporaryDirectory() as td:
+        trainer = _make_trainer(
+            ckpt_dir=td, mesh=mesh, checkpoint_format="sharded"
+        )
+        loader = dpx.data.DeviceLoader(_dataset(), 64, mesh=mesh, seed=0)
+        trainer.fit(loader, epochs=3)
+        latest = os.path.join(td, ckpt_lib.LATEST_NAME)
+        versions = sorted(glob.glob(
+            os.path.join(td, ckpt_lib.LATEST_NAME + ".shards", "*")
+        ))
+        shard = glob.glob(os.path.join(versions[-1], "shard_*.msgpack"))[0]
+        chaos.corrupt_file(shard, mode="truncate")
+        _state, epoch, _extra = ckpt_lib.load_checkpoint(
+            latest, trainer.state, trainer.state_shardings,
+            on_event=lambda kind, **f: events.append({"event": kind, **f}),
+        )
+    fallbacks = [e for e in events if e["event"] == "checkpoint_fallback"]
+    return {
+        "ok": len(fallbacks) == 1 and epoch >= 1,
+        "action": "fallback-to-older-version",
+        "restored_epoch": int(epoch),
+        "versions": len(versions),
+    }
+
+
+def scenario_io_flake() -> dict:
+    """Transient OSError on the first two `latest` writes: saver retries."""
+    import tempfile
+
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.robustness import chaos
+    from distributed_pytorch_example_tpu.train import checkpoint as ckpt_lib
+
+    mesh = dpx.runtime.make_mesh()
+    chaos.install(chaos.ChaosPlan(
+        faults=[chaos.Fault("io-error", path_substr="latest", count=2)]
+    ))
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            trainer = _make_trainer(
+                ckpt_dir=td, mesh=mesh, save_every_steps=2
+            )
+            loader = dpx.data.DeviceLoader(
+                _dataset(), 64, mesh=mesh, seed=0
+            )
+            trainer.fit(loader, epochs=2)
+            written = os.path.exists(
+                os.path.join(td, ckpt_lib.LATEST_NAME)
+            )
+            retries = trainer._saver.io_retries_used
+    finally:
+        chaos.uninstall()
+    return {
+        "ok": written and retries >= 1,
+        "action": "retry-with-backoff",
+        "io_retries_used": retries,
+    }
+
+
+def scenario_rendezvous_flake() -> dict:
+    """First two rendezvous attempts fail: bounded backoff retry."""
+    from distributed_pytorch_example_tpu.robustness import chaos
+    from distributed_pytorch_example_tpu.runtime import (
+        distributed as dist,
+    )
+
+    fault = chaos.Fault("rendezvous-flake", count=2)
+    chaos.install(chaos.ChaosPlan(faults=[fault]))
+    was_initialized = dist._initialized
+    dist._initialized = False
+    os.environ["DPX_RENDEZVOUS_BACKOFF"] = "0.01"
+    try:
+        dist.initialize()
+    finally:
+        dist._initialized = was_initialized or dist._initialized
+        os.environ.pop("DPX_RENDEZVOUS_BACKOFF", None)
+        chaos.uninstall()
+    return {
+        "ok": fault.fired == 2,
+        "action": "retry-with-backoff",
+        "attempts": fault.fired + 1,
+    }
+
+
+def scenario_torn_save_kill() -> dict:
+    """SIGKILL mid-sharded-save (post-shards, pre-manifest/pointer): the
+    resume run must land on the previous intact version."""
+    import tempfile
+
+    from distributed_pytorch_example_tpu.robustness import chaos
+
+    with tempfile.TemporaryDirectory() as td:
+        plan = chaos.ChaosPlan(faults=[
+            chaos.Fault("kill", at="sharded-save:post-shards", nth=2)
+        ])
+        crash = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "torn-train", "--dir", td],
+            env=_child_env(plan.to_json()), capture_output=True, text=True,
+            cwd=REPO_ROOT, timeout=600,
+        )
+        killed = crash.returncode == -signal.SIGKILL
+        resume = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "torn-resume", "--dir", td],
+            env=_child_env(), capture_output=True, text=True,
+            cwd=REPO_ROOT, timeout=600,
+        )
+        try:
+            info = json.loads(resume.stdout.strip().splitlines()[-1])
+        except (json.JSONDecodeError, IndexError):
+            info = {"error": resume.stderr[-500:]}
+    return {
+        "ok": killed and resume.returncode == 0
+        and info.get("resumed_epoch") is not None,
+        "action": "resume-from-intact-ancestor",
+        "killed": killed,
+        **info,
+    }
+
+
+def scenario_sigint() -> dict:
+    """SIGINT a training child: checkpoint lands, exit code 130."""
+    import tempfile
+
+    from distributed_pytorch_example_tpu.train import checkpoint as ckpt_lib
+
+    with tempfile.TemporaryDirectory() as td:
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "sigint-train", "--dir", td],
+            env=_child_env(), stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE, text=True, cwd=REPO_ROOT,
+        )
+        latest = os.path.join(td, ckpt_lib.LATEST_NAME)
+        deadline = time.time() + 300
+        while time.time() < deadline and not os.path.exists(latest):
+            if child.poll() is not None:
+                break
+            time.sleep(0.25)
+        child.send_signal(signal.SIGINT)
+        try:
+            _, err = child.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            _, err = child.communicate()
+        written = os.path.exists(latest)
+    return {
+        "ok": child.returncode == 130 and written,
+        "action": "checkpoint-and-exit-130",
+        "exit_code": child.returncode,
+        "checkpoint_written": written,
+    }
+
+
+SCENARIOS = {
+    "nan-skip": lambda: scenario_poison_skip("nan-batch"),
+    "inf-skip": lambda: scenario_poison_skip("inf-batch"),
+    "budget-rollback": scenario_budget_rollback,
+    "corrupt-latest": scenario_corrupt_latest,
+    "truncate-shard": scenario_truncate_shard,
+    "io-flake": scenario_io_flake,
+    "rendezvous-flake": scenario_rendezvous_flake,
+    "torn-save-kill": scenario_torn_save_kill,
+    "sigint": scenario_sigint,
+}
+assert set(SCENARIOS) == set(ALL)
+
+
+# -- child payloads (subprocess scenarios) --------------------------------
+
+def _run_child(phase: str, ckpt_dir: str) -> int:
+    _force_cpu_mesh()
+    import distributed_pytorch_example_tpu as dpx
+
+    mesh = dpx.runtime.make_mesh()
+    loader = dpx.data.DeviceLoader(_dataset(), 64, mesh=mesh, seed=0)
+    if phase == "torn-train":
+        # sharded + frequent saves; the DPX_CHAOS kill fault SIGKILLs this
+        # process mid-save on the save's second visit
+        trainer = _make_trainer(
+            ckpt_dir=ckpt_dir, mesh=mesh, checkpoint_format="sharded",
+            save_every_steps=1,
+        )
+        trainer.fit(loader, epochs=3)
+        return 1  # the kill fault should have fired; surviving is a FAIL
+    if phase == "torn-resume":
+        from distributed_pytorch_example_tpu.train import (
+            checkpoint as ckpt_lib,
+        )
+
+        trainer = _make_trainer(
+            ckpt_dir=ckpt_dir, mesh=mesh, checkpoint_format="sharded",
+        )
+        trainer.init(next(iter(loader))["x"])
+        events = []
+        _state, epoch, extra = ckpt_lib.load_checkpoint(
+            os.path.join(ckpt_dir, ckpt_lib.LATEST_NAME),
+            trainer.state, trainer.state_shardings,
+            on_event=lambda kind, **f: events.append(kind),
+        )
+        print(json.dumps({
+            "resumed_epoch": int(epoch),
+            "batch_in_epoch": (extra or {}).get("batch_in_epoch"),
+            "checkpoint_fallbacks": events.count("checkpoint_fallback"),
+        }))
+        return 0
+    if phase == "sigint-train":
+        trainer = _make_trainer(
+            ckpt_dir=ckpt_dir, mesh=mesh, save_every_steps=1,
+        )
+        try:
+            trainer.fit(loader, epochs=10_000)
+        except dpx.train.PreemptionInterrupt as e:
+            return e.exit_code
+        return 1  # ran to completion without the signal: FAIL
+    raise SystemExit(f"unknown child phase {phase!r}")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--fast", action="store_true",
+                        help=f"only the fast subset: {', '.join(FAST)}")
+    parser.add_argument("--scenarios", default=None,
+                        help="comma-separated subset (default: all)")
+    parser.add_argument("--child", default=None, help=argparse.SUPPRESS)
+    parser.add_argument("--dir", default=None, help=argparse.SUPPRESS)
+    args = parser.parse_args()
+
+    if args.child:
+        return _run_child(args.child, args.dir)
+
+    names = (
+        args.scenarios.split(",") if args.scenarios
+        else list(FAST if args.fast else ALL)
+    )
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        parser.error(f"unknown scenario(s) {unknown}; choices: {list(ALL)}")
+
+    _force_cpu_mesh()
+    failures = 0
+    for name in names:
+        t0 = time.time()
+        try:
+            report = SCENARIOS[name]()
+        except Exception as e:  # noqa: BLE001 - a crash is a FAIL line
+            report = {"ok": False, "action": "crashed", "error": repr(e)}
+        report = {
+            "scenario": name,
+            **report,
+            "elapsed_s": round(time.time() - t0, 2),
+        }
+        failures += 0 if report["ok"] else 1
+        print(json.dumps(report), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -160,6 +160,39 @@ def test_debug_callback_suppression_and_scope():
     assert pylint_rules.lint_source("ops/fused.py", src3) == []
 
 
+def test_nan_launder_fires_in_scope():
+    src = (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def step(g):\n"
+        "    g = jnp.nan_to_num(g)\n"
+        "    h = np.nan_to_num(g, nan=0.0)\n"
+        "    return g, h\n"
+    )
+    findings = pylint_rules.lint_source("train/step.py", src)
+    assert _rules(findings) == ["nan-launder", "nan-launder"]
+    assert "launders" in findings[0].message
+    # ops/ is in scope too
+    assert _rules(
+        pylint_rules.lint_source("ops/fused.py", src)
+    ) == ["nan-launder", "nan-launder"]
+
+
+def test_nan_launder_suppression_and_scope():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def step(g):\n"
+        "    return jnp.nan_to_num(g)  # graft-lint: nan-launder\n"
+    )
+    assert pylint_rules.lint_source("train/step.py", src) == []
+    # outside ops//train/ (analysis tooling, scripts) the rule stays quiet
+    src2 = "import numpy as np\ndef f(x):\n    return np.nan_to_num(x)\n"
+    assert pylint_rules.lint_source("analysis/numerics.py", src2) == []
+    # unrelated names don't trip it
+    src3 = "def f(x):\n    return x.nan_guard()\n"
+    assert pylint_rules.lint_source("train/step.py", src3) == []
+
+
 def test_real_instrumented_step_lints_clean():
     # the acceptance gate: the sentinel-instrumented train step passes the
     # full AST rule set (host-sync AND debug-callback) as committed

@@ -75,10 +75,15 @@ def test_sharded_save_writes_no_replicated_duplicates(tmp_path, devices):
     state, _ = init_state(model, optax.adam(1e-3), x, jax.random.key(0), part)
     path = str(tmp_path / "ck")
     ckpt_lib.save_checkpoint(path, state, 1, 0.0, sharded=True)
-    with open(
-        os.path.join(f"{path}.shards", "00000001", "shard_00000.msgpack"), "rb"
-    ) as f:
-        chunks = serialization.msgpack_restore(f.read())
+    # shard files are sealed in the CRC envelope (graft-armor);
+    # read_verified strips + checks it
+    from distributed_pytorch_example_tpu.robustness.integrity import (
+        read_verified,
+    )
+
+    chunks = serialization.msgpack_restore(read_verified(
+        os.path.join(f"{path}.shards", "00000001", "shard_00000.msgpack")
+    ))
     for p, entries in chunks.items():
         assert len(entries) == 1, f"{p} saved {len(entries)} copies"
 
@@ -116,11 +121,13 @@ def test_gathered_and_sharded_interchangeable(tmp_path, devices):
 
 
 def test_sharded_gc_keeps_only_live_version(tmp_path, devices):
+    """retain=1 reproduces the pre-r10 single-live-version GC; the
+    keep-last-K default (DEFAULT_RETAIN) is covered in tests/test_chaos.py."""
     mesh = make_mesh(MeshSpec(data=1, fsdp=8))
     state, _ = _fsdp_state(mesh)
     path = str(tmp_path / "ck")
-    ckpt_lib.save_checkpoint(path, state, 1, 0.0, sharded=True)
-    ckpt_lib.save_checkpoint(path, state, 2, 0.0, sharded=True)
+    ckpt_lib.save_checkpoint(path, state, 1, 0.0, sharded=True, retain=1)
+    ckpt_lib.save_checkpoint(path, state, 2, 0.0, sharded=True, retain=1)
     versions = sorted(os.listdir(f"{path}.shards"))
     assert versions == ["00000002"]
 
@@ -239,10 +246,13 @@ def test_best_and_latest_shard_roots_are_independent(tmp_path, devices):
     best = str(tmp_path / "best_model.ckpt")
     latest = str(tmp_path / "latest_model.ckpt")
 
-    ckpt_lib.save_checkpoint(best, state, 3, 0.5, sharded=True)
-    # latest advances several epochs past best
+    ckpt_lib.save_checkpoint(best, state, 3, 0.5, sharded=True, retain=1)
+    # latest advances several epochs past best (retain=1: single live
+    # version per root, so cross-root GC bleed would be visible)
     for epoch in (3, 4, 5):
-        ckpt_lib.save_checkpoint(latest, state, epoch, 0.4, sharded=True)
+        ckpt_lib.save_checkpoint(
+            latest, state, epoch, 0.4, sharded=True, retain=1
+        )
 
     _, best_epoch, _ = ckpt_lib.load_checkpoint(best, state, shardings)
     _, latest_epoch, _ = ckpt_lib.load_checkpoint(latest, state, shardings)

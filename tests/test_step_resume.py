@@ -150,6 +150,44 @@ def test_sigkill_mid_epoch_resumes_bit_identical(tmp_path):
         assert ctrl_rec[k] == res_rec[k], (k, ctrl_rec[k], res_rec[k])
 
 
+@pytest.mark.slow
+def test_torn_sharded_save_resumes_previous_intact_checkpoint(tmp_path):
+    """SIGKILL between the shard writes and the manifest/pointer flip
+    (graft-armor chaos crash point): the torn version is never committed,
+    so the pointer still names the previous intact version and resume
+    lands on it — no operator intervention, no fallback walk needed."""
+    ckdir = str(tmp_path / "ck")
+    args = [
+        "--epochs", "1", "--num-samples", "640", "--batch-size", "2",
+        "--log-every", "1", "--seed", "5", "--checkpoint-dir", ckdir,
+        "--checkpoint-format", "sharded", "--save-every-steps", "1",
+    ]
+    plan = json.dumps({"faults": [
+        {"kind": "kill", "at": "sharded-save:post-shards", "nth": 3},
+    ]})
+    victim = subprocess.run(
+        [sys.executable, os.path.join(REPO, "train.py"), *args,
+         "--chaos", plan],
+        capture_output=True, text=True, env=_env(), cwd=REPO, timeout=600,
+    )
+    assert victim.returncode == -signal.SIGKILL, victim.stderr[-2000:]
+
+    # saves 1 and 2 committed; save 3 died post-shards: its version dir
+    # has shard files but no manifest, and the pointer still names save 2
+    latest = os.path.join(ckdir, "latest_model.ckpt")
+    assert os.path.isfile(latest)
+    versions = sorted(os.listdir(latest + ".shards"))
+    assert len(versions) == 3, versions
+    torn = os.path.join(latest + ".shards", versions[-1])
+    assert not os.path.exists(os.path.join(torn, "manifest.msgpack"))
+
+    res_err = _run([*args, "--resume", latest])
+    m = re.search(r"Resuming epoch (\d+) at batch (\d+)/40", res_err)
+    assert m, res_err[-2000:]
+    # batch 2 = the second (last intact) mid-epoch save's cursor
+    assert (int(m.group(1)), int(m.group(2))) == (0, 2)
+
+
 def test_iter_from_matches_tail_of_full_iteration(devices):
     """loader.iter_from(k) yields exactly the batches a full iteration
     yields from step k on (the cursor contract resume relies on)."""

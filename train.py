@@ -113,6 +113,16 @@ def main():
     args = parser.parse_args()
 
     dpx.runtime.setup_logging()
+    if args.chaos:
+        # install BEFORE initialize(): rendezvous-flake faults must see the
+        # plan; equivalent to launching with DPX_CHAOS=<value>
+        from distributed_pytorch_example_tpu.robustness import chaos
+
+        chaos.install(
+            chaos.ChaosPlan.from_json(args.chaos)
+            if args.chaos.lstrip().startswith("{")
+            else chaos.preset(args.chaos)
+        )
     config = dpx.runtime.initialize()
 
     import jax
@@ -336,6 +346,9 @@ def main():
         save_every_steps=args.save_every_steps,
         telemetry=not args.no_telemetry,
         telemetry_every=args.telemetry_every,
+        max_bad_steps=args.max_bad_steps,
+        skip_nonfinite=not args.no_skip_nonfinite,
+        checkpoint_retain=args.checkpoint_retain,
     )
     try:
         trainer.fit(
@@ -344,13 +357,17 @@ def main():
             epochs=args.epochs,
             resume=args.resume,
         )
-    except dpx.train.PreemptionInterrupt:
-        # graceful SIGTERM teardown: the checkpoint landed in fit(); exit
-        # with the conventional TERM rc so the launcher does NOT restart
-        # (launch/entrypoint.sh:133-141) — the next launch resumes at the
-        # saved batch
+    except dpx.train.PreemptionInterrupt as e:
+        # graceful SIGTERM/SIGINT teardown: the checkpoint landed in fit();
+        # exit with the conventional rc (143 TERM / 130 INT) so the launcher
+        # does NOT restart (launch/entrypoint.sh:133-141) — the next launch
+        # resumes at the saved batch
         dpx.runtime.shutdown()
-        sys.exit(143)
+        sys.exit(e.exit_code)
+    except dpx.train.BadStepBudgetExceeded:
+        logger.exception("graft-armor: persistent nonfinite fault; aborting")
+        dpx.runtime.shutdown()
+        sys.exit(1)
     dpx.runtime.shutdown()
 
 
