@@ -261,6 +261,34 @@ def run_model(name: str, args) -> dict:
         opt_bytes = dpx.train.opt_state_bytes_per_chip(
             trainer.state.opt_state
         )
+        reshard_report = None
+        if args.reshard_from:
+            # graft-elastic: reload a (possibly other-mesh) checkpoint onto
+            # THIS run's mesh and report the cost — reshard_ms is the full
+            # reassemble + re-slice wall time, resume_gap_steps the
+            # optimizer steps the restored cursor trails the newest
+            # on-disk version by (None when unknowable)
+            from distributed_pytorch_example_tpu.robustness import elastic
+            from distributed_pytorch_example_tpu.train import (
+                checkpoint as ckpt_lib,
+            )
+
+            t0 = time.perf_counter()
+            restored, r_epoch, r_extra = ckpt_lib.load_checkpoint(
+                args.reshard_from, trainer.state, trainer.state_shardings
+            )
+            # value fetch, not block_until_ready: only a real device->host
+            # transfer reliably fences under the tunneled TPU platform
+            np.asarray(jax.tree_util.tree_leaves(restored.params)[0])
+            reshard_ms = (time.perf_counter() - t0) * 1000.0
+            trainer.state = restored
+            reshard_report = {
+                "reshard_ms": round(reshard_ms, 3),
+                "resume_gap_steps": elastic.resume_gap_steps(
+                    args.reshard_from, r_epoch, r_extra
+                ),
+                "restored_epoch": r_epoch,
+            }
         # AOT-compile once and drive the SAME executable for warmup and the
         # timed loop (a separate jit call would compile a second copy)
         step = trainer.train_step.lower(trainer.state, batch).compile()
@@ -347,6 +375,11 @@ def run_model(name: str, args) -> dict:
     }
     if chaos_report is not None:
         result["chaos"] = chaos_report
+    if reshard_report is not None:
+        result["reshard_ms"] = reshard_report["reshard_ms"]
+        result["resume_gap_steps"] = reshard_report["resume_gap_steps"]
+        result["restored_epoch"] = reshard_report["restored_epoch"]
+        result["config"]["reshard_from"] = args.reshard_from
     peak = cost.get("peak_bf16_flops")
     if flops_per_step is not None and peak is not None:
         # cost_analysis is of the per-device partitioned executable, so
@@ -417,6 +450,13 @@ def main():
     parser.add_argument("--pipe-no-recompute", action="store_true",
                         help="1f1b activation-stash backward (no stage "
                         "replay) for the --mesh-pipe ablation")
+    parser.add_argument("--reshard-from", default=None, metavar="CKPT",
+                        help="load this checkpoint (either format, any "
+                        "stamped mesh shape) onto the bench mesh before "
+                        "timing (graft-elastic); records reshard_ms (full "
+                        "reassemble + re-slice wall time) and "
+                        "resume_gap_steps, and runs the timed loop from "
+                        "the restored state")
     parser.add_argument("--chaos", default="none",
                         choices=("none", "nan-step", "io-flake"),
                         help="post-timing fault-injection demo (graft-"

@@ -1,6 +1,6 @@
 """Repo-specific Python AST lints (no jax import, no backend).
 
-Six rules, each a distilled past-regression class:
+Seven rules, each a distilled past-regression class:
 
 - ``host-sync``: ``.item()`` / ``np.asarray`` / ``jax.device_get`` inside
   TRACED-SCOPE sources (``ops/``, ``models/``, ``parallel/``,
@@ -40,6 +40,14 @@ Six rules, each a distilled past-regression class:
   (``telemetry/sentinels.py``) + device-side update predication + the
   Trainer's bounded bad-step budget (graft-armor) — never value
   rewriting. Deliberate exceptions carry ``# graft-lint: nan-launder``.
+- ``ckpt-stamp``: a ``msgpack_serialize`` call inside
+  ``train/checkpoint.py`` from a function that never references the
+  ``mesh_manifest`` stamp. Every checkpoint write must carry the
+  format-3 mesh-topology manifest (graft-elastic), or the artifact can
+  only ever be resumed on the exact mesh that wrote it — and elastic
+  shrink-to-survivors resume from it raises. A write path added beside
+  ``_write_payload`` / ``_save_sharded`` that forgets the stamp silently
+  regresses cross-mesh resume; this rule makes that a lint failure.
 
 Scope is static and name-based, not a whole-program call graph — the
 cheap 99% of the check. Deliberate exceptions carry a
@@ -63,6 +71,7 @@ MESH_GUESS_SCOPE = ("ops/",)
 BF16_ACCUM_SCOPE = ("ops/", "train/")
 DEBUG_CALLBACK_SCOPE = ("ops/", "train/step.py")
 NAN_LAUNDER_SCOPE = ("ops/", "train/")
+CKPT_STAMP_SCOPE = ("train/checkpoint.py",)
 
 _ACCUM_CTORS = ("zeros", "zeros_like", "full", "empty")
 
@@ -198,6 +207,67 @@ def _bf16_accum_findings(
                     "accumulate in float32 and cast once after the loop"
                 ),
             ))
+    return [flagged[k] for k in sorted(flagged)]
+
+
+def _references_mesh_manifest(func: ast.AST) -> bool:
+    """Whether a function touches the stamp by any spelling: a
+    ``mesh_manifest`` name/parameter/keyword, an attribute access
+    (``elastic.mesh_manifest``, ``elastic.MANIFEST_KEY``), or the literal
+    manifest key string."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Name) and node.id == "mesh_manifest":
+            return True
+        if isinstance(node, ast.arg) and node.arg == "mesh_manifest":
+            return True
+        if isinstance(node, ast.keyword) and node.arg == "mesh_manifest":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in (
+            "mesh_manifest", "MANIFEST_KEY"
+        ):
+            return True
+        if isinstance(node, ast.Constant) and node.value == "mesh_manifest":
+            return True
+    return False
+
+
+def _ckpt_stamp_findings(
+    tree: ast.Module, relpath: str, supp: Dict[int, Set[str]]
+) -> List[Finding]:
+    """msgpack_serialize writes that bypass the mesh-manifest stamp."""
+    # spans of functions that DO reference the stamp: any serialize call
+    # inside one is sanctioned (the stamp rides in that function's payload)
+    ok_spans = [
+        (func.lineno, func.end_lineno or func.lineno)
+        for func in ast.walk(tree)
+        if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and _references_mesh_manifest(func)
+    ]
+    flagged: Dict[int, Finding] = {}  # keyed by line: nesting dedup
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if name != "msgpack_serialize":
+            continue
+        if any(a <= node.lineno <= b for a, b in ok_spans):
+            continue
+        if _suppressed(supp, node.lineno, "ckpt-stamp"):
+            continue
+        flagged.setdefault(node.lineno, Finding(
+            rule="ckpt-stamp",
+            where=f"{relpath}:{node.lineno}",
+            message=(
+                "checkpoint write bypasses the mesh-manifest stamp: "
+                "msgpack_serialize in a function that never references "
+                "mesh_manifest — unstamped artifacts cannot be resumed "
+                "across mesh shapes (graft-elastic); thread the "
+                "mesh_manifest through like _write_payload/_save_sharded"
+            ),
+        ))
     return [flagged[k] for k in sorted(flagged)]
 
 
@@ -376,6 +446,8 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
     visitor.visit(tree)
     if _in_scope(relpath, BF16_ACCUM_SCOPE):
         findings.extend(_bf16_accum_findings(tree, relpath, supp))
+    if _in_scope(relpath, CKPT_STAMP_SCOPE):
+        findings.extend(_ckpt_stamp_findings(tree, relpath, supp))
     return findings
 
 

@@ -31,6 +31,13 @@
 #                   recovers if every host exits (peers blocked in a
 #                   collective must be restarted by the orchestrator).
 #   CHECKPOINT_DIR  fallback checkpoint dir               [default ./checkpoints]
+#   DPX_ELASTIC     "1": if a restarted host exhausts its rendezvous retry
+#                   budget because peers are gone for good (slice
+#                   preemption), it probes every peer, dense-renumbers the
+#                   survivors and re-joins as a smaller world instead of
+#                   failing (runtime/distributed.py shrink_to_survivors);
+#                   the resume checkpoint is resharded onto the shrunken
+#                   mesh via its format-3 mesh manifest  [default off]
 #
 # Derived (reference entrypoint.sh:24-28 parity):
 #   PROCESS_ID          <- numeric suffix of $HOSTNAME   (NODE_RANK=${HOSTNAME##*-})

@@ -10,11 +10,23 @@ Two halves that validate each other (ISSUE 5):
 - the chaos harness (:mod:`.chaos`) that injects seeded, replayable
   faults at exactly those surfaces so every recovery path is provable
   (``tests/test_chaos.py``, ``scripts/chaos_sweep.py``).
+
+graft-elastic (ISSUE 6) adds :mod:`.elastic`: the format-3 mesh-topology
+manifest stamped into every checkpoint, cross-mesh resume validation,
+and the ``DPX_ELASTIC=1`` gate for shrink-to-survivors rendezvous
+(``runtime/distributed.py``) and newest-intact-wins fallback ordering
+(``train/checkpoint.py``).
 """
 
 from distributed_pytorch_example_tpu.robustness.chaos import (  # noqa: F401
     ChaosPlan,
     Fault,
+)
+from distributed_pytorch_example_tpu.robustness.elastic import (  # noqa: F401
+    MANIFEST_FORMAT,
+    MissingMeshManifestError,
+    elastic_enabled,
+    mesh_manifest,
 )
 from distributed_pytorch_example_tpu.robustness.integrity import (  # noqa: F401
     CheckpointCorruptError,

@@ -22,7 +22,9 @@ Fault kinds:
   AsyncSaver retry path);
 - ``kill`` — SIGKILL the current process the ``nth`` time the named
   crash point is reached (e.g. ``sharded-save:post-shards`` — between
-  shard-file writes and the manifest/pointer commit: a torn save);
+  shard-file writes and the manifest/pointer commit: a torn save; or
+  ``step`` — the per-step boundary in ``train/loop.py``, the
+  kill-a-slice site graft-elastic's shrink-to-survivors scenario uses);
 - ``rendezvous-flake`` — fail (after an optional delay) the next
   ``count`` entries into the named transient site (e.g. coordinator
   rendezvous in ``runtime/distributed.initialize``).

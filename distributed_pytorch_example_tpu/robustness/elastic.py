@@ -1,0 +1,263 @@
+"""graft-elastic: mesh-shape-agnostic checkpoint resume (format 3).
+
+The r10 checkpoint formats already reassemble full logical arrays on load
+and re-shard them onto the target layout (``train/checkpoint.py`` module
+docstring), so a checkpoint mechanically restores under any mesh. What
+was missing is everything that makes cross-mesh resume *operable*:
+
+- a **mesh-topology manifest** stamped into every save (``format: 3``,
+  key ``mesh_manifest``): mesh axis names/sizes, per-leaf PartitionSpecs,
+  and the ZeRO-1 scatter dims — derived from the live state's
+  NamedShardings, so the stamp always reflects what was actually saved;
+- **resume validation** (:func:`validate_resume`): elastic resume
+  (``DPX_ELASTIC=1``) from an unstamped pre-format-3 checkpoint raises
+  :class:`MissingMeshManifestError` naming the missing manifest instead
+  of silently assuming the topology; stamped cross-mesh restores are
+  logged with the stamped → target shape delta;
+- **elastic fallback ordering**: under ``DPX_ELASTIC=1`` the newest
+  intact checkpoint wins regardless of stamped mesh shape; without it
+  the intact-ancestor walk-back prefers same-mesh ancestors
+  (``load_checkpoint``);
+- the **shrink-to-survivors** launcher path lives in
+  ``runtime/distributed.py`` (:func:`elastic_enabled` gates it there
+  too), and ``scripts/reshard_check.py`` turns the stamp into an
+  offline per-leaf reshard plan.
+
+Mesh axes are compared CANONICALLY — size-1 axes dropped — so e.g. a
+``data=8`` mesh and a ``data=8, tensor=1`` mesh are the same topology
+(a ZeRO-1 flip on the same device set never reads as a mesh change).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, List, Optional, Union
+
+import jax
+
+from distributed_pytorch_example_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+# checkpoint manifest format carrying the mesh stamp. 1 = pre-r10
+# unsealed, 2 = r10 CRC-sealed (implicit, unstamped), 3 = stamped.
+MANIFEST_FORMAT = 3
+MANIFEST_KEY = "mesh_manifest"
+ELASTIC_ENV = "DPX_ELASTIC"
+
+# mirrors parallel/api.py's opt-state path test (kept local: robustness
+# must not import the parallel layer)
+_OPT_STATE_RE = re.compile(r"(^|/)opt_state(/|$)")
+_VERSION_RE = re.compile(r"\d{8}(\.\d{8})?")
+_HISTORY_RE = re.compile(r"\d{8}\.ckpt")
+
+# one PartitionSpec dim serialized for msgpack: None (unsharded), one
+# axis name, or a list of axis names
+SpecEntry = Union[None, str, List[str]]
+
+
+class MissingMeshManifestError(RuntimeError):
+    """Elastic cross-mesh resume attempted from an unstamped checkpoint.
+
+    Pre-format-3 (r10 and older) checkpoints carry no ``mesh_manifest``,
+    so the loader cannot know what topology they were saved under. They
+    keep loading under the legacy contract — same mesh shape, no
+    validation — but ``DPX_ELASTIC=1`` resume refuses them loudly
+    instead of guessing.
+    """
+
+
+def elastic_enabled(env: Optional[dict] = None) -> bool:
+    """True when ``DPX_ELASTIC`` is set truthy (elastic resume mode)."""
+    val = (env if env is not None else os.environ).get(ELASTIC_ENV, "")
+    return val not in ("", "0", "false", "False")
+
+
+def _path_str(key_path) -> str:
+    # must produce the same '/'-joined paths as train/checkpoint.py's
+    # _path_str — manifest spec keys index the same flatten
+    parts = []
+    for p in key_path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(p, attr):
+                parts.append(str(getattr(p, attr)))
+                break
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _spec_entries(spec) -> List[SpecEntry]:
+    entries: List[SpecEntry] = []
+    for dim in tuple(spec):
+        if dim is None:
+            entries.append(None)
+        elif isinstance(dim, (tuple, list)):
+            entries.append([str(a) for a in dim])
+        else:
+            entries.append(str(dim))
+    return entries
+
+
+def _entry_axes(entry: SpecEntry) -> List[str]:
+    if entry is None:
+        return []
+    if isinstance(entry, (list, tuple)):
+        return [str(a) for a in entry]
+    return [str(entry)]
+
+
+def canonical_axes(axes: Optional[dict]) -> Optional[Dict[str, int]]:
+    """Axis-name → size with size-1 axes dropped (topology identity)."""
+    if axes is None:
+        return None
+    return {str(k): int(v) for k, v in axes.items() if int(v) != 1}
+
+
+def mesh_manifest(state: Any) -> Optional[dict]:
+    """Format-3 mesh stamp derived from the LIVE state's shardings.
+
+    Returns ``None`` when no leaf carries a NamedSharding (pure-host
+    state) — the save then stays unstamped, which loads under the
+    legacy same-mesh contract.
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    axes: Optional[dict] = None
+    specs: Dict[str, List[SpecEntry]] = {}
+    zero1_dims: Dict[str, int] = {}
+    for key_path, leaf in flat:
+        sharding = getattr(leaf, "sharding", None)
+        if not isinstance(sharding, jax.sharding.NamedSharding):
+            continue
+        p = _path_str(key_path)
+        if axes is None:
+            axes = {
+                str(k): int(v) for k, v in sharding.mesh.shape.items()
+            }
+        entries = _spec_entries(sharding.spec)
+        specs[p] = entries
+        if _OPT_STATE_RE.search(p):
+            for dim, entry in enumerate(entries):
+                if "data" in _entry_axes(entry):
+                    zero1_dims[p] = dim
+                    break
+    if axes is None:
+        return None
+    return {
+        "format": MANIFEST_FORMAT,
+        "axes": axes,
+        "specs": specs,
+        "zero1_dims": zero1_dims,
+    }
+
+
+def tree_mesh_axes(tree: Any) -> Optional[Dict[str, int]]:
+    """Target mesh axes from a shardings tree OR a live state template."""
+    if tree is None:
+        return None
+    for leaf in jax.tree_util.tree_leaves(
+        tree, is_leaf=lambda x: x is None
+    ):
+        sharding = (
+            leaf
+            if isinstance(leaf, jax.sharding.NamedSharding)
+            else getattr(leaf, "sharding", None)
+        )
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            return {
+                str(k): int(v) for k, v in sharding.mesh.shape.items()
+            }
+    return None
+
+
+def validate_resume(
+    stamp: Optional[dict],
+    target_axes: Optional[dict],
+    source: str,
+    elastic: Optional[bool] = None,
+) -> Optional[dict]:
+    """Gate one restore attempt on the manifest stamp; returns the stamp.
+
+    - unstamped + ``DPX_ELASTIC=1`` → :class:`MissingMeshManifestError`
+      (elastic resume needs to know the saved topology);
+    - unstamped otherwise → legacy same-mesh contract, no validation;
+    - stamped + shape change → allowed in BOTH modes (the sharded format
+      has promised cross-mesh restore since r5), logged loudly so a
+      surprise reshard is visible in the run log.
+    """
+    if elastic is None:
+        elastic = elastic_enabled()
+    if not isinstance(stamp, dict):
+        stamp = None
+    if stamp is None:
+        if elastic:
+            raise MissingMeshManifestError(
+                f"{source}: checkpoint has no '{MANIFEST_KEY}' stamp "
+                f"(pre-format-{MANIFEST_FORMAT}, r10 or older). Elastic "
+                f"resume ({ELASTIC_ENV}=1) cannot verify the saved mesh "
+                "topology; resume on the original mesh shape with "
+                f"{ELASTIC_ENV} unset (which re-stamps on the next "
+                "save), then retry elastically."
+            )
+        return None
+    stamped = canonical_axes(stamp.get("axes", {}))
+    target = canonical_axes(target_axes)
+    if target is not None and stamped != target:
+        logger.warning(
+            "Cross-mesh resume from %s: checkpoint stamped %s, restoring "
+            "onto %s (%s)", source, stamped, target,
+            "elastic mode" if elastic else "reshard-on-load",
+        )
+    return stamp
+
+
+def _parse_version(name: str):
+    if "." in name:
+        epoch, batch = name.split(".", 1)
+        return int(epoch), int(batch)
+    return int(name), 0
+
+
+def resume_gap_steps(
+    path: str, restored_epoch: int, restored_extra: Optional[dict] = None
+) -> Optional[int]:
+    """Steps between the restored cursor and the newest save attempt.
+
+    0 means the newest checkpoint restored (no work lost); a positive
+    number counts the optimizer steps between the restored mid-epoch
+    cursor and the newest (possibly torn) save of the SAME epoch; None
+    means the gap spans an epoch boundary (steps-per-epoch unknown
+    offline) or is undeterminable for the format.
+    """
+    restored = (
+        int(restored_epoch),
+        int((restored_extra or {}).get("batch_in_epoch") or 0),
+    )
+    shards = f"{path}.shards"
+    if os.path.isdir(shards):
+        names = sorted(
+            n for n in os.listdir(shards) if _VERSION_RE.fullmatch(n)
+        )
+        if not names:
+            return None
+        newest = _parse_version(names[-1])
+        if newest == restored:
+            return 0
+        if newest[0] == restored[0]:
+            return max(newest[1] - restored[1], 0)
+        return None
+    history = f"{path}.history"
+    if os.path.isdir(history):
+        names = sorted(
+            n for n in os.listdir(history) if _HISTORY_RE.fullmatch(n)
+        )
+        if names:
+            try:
+                if os.path.samefile(os.path.join(history, names[-1]), path):
+                    return 0
+            except OSError:
+                pass
+        return None
+    # single-artifact checkpoint: nothing newer can exist
+    return 0

@@ -108,32 +108,96 @@ def resolve_config(env: Optional[dict] = None) -> DistributedConfig:
     return DistributedConfig(num_processes, int(process_id), coordinator)
 
 
-def initialize(
-    config: Optional[DistributedConfig] = None,
-    max_attempts: Optional[int] = None,
-) -> DistributedConfig:
-    """Join the multi-host job (reference ``setup_distributed``, train.py:70-82).
+def peer_address(config: DistributedConfig, process_id: int) -> str:
+    """Host ``process_id``'s address derived from the coordinator's.
 
-    No-op for single-process topologies; idempotent.
-
-    The coordinator rendezvous is retried with bounded exponential backoff
-    (graft-armor): hosts of a preempted-and-rescheduled job come up at
-    different times, and the first connect to a coordinator that is not
-    listening yet is a TRANSIENT failure, not a config error. Knobs:
-    ``max_attempts`` (default ``$DPX_RENDEZVOUS_RETRIES`` + 1 = 4 total)
-    and ``$DPX_RENDEZVOUS_BACKOFF`` (base delay seconds, default 1.0).
+    The launch contract names hosts ``{base}-{k}`` behind one headless
+    service (entrypoint.sh / reference entrypoint.sh:24-28), so peer k's
+    address is the coordinator address with the replica index swapped:
+    ``myjob-0.svc:29500`` → ``myjob-3.svc:29500``.
     """
-    global _initialized
-    # function-local import: robustness must stay importable before the
-    # runtime package finishes initializing (no cycle at module load)
-    from distributed_pytorch_example_tpu.robustness import chaos, retry
+    if not config.coordinator_address:
+        raise ValueError("peer_address needs a distributed config")
+    hostport = config.coordinator_address
+    host, _, port = hostport.rpartition(":")
+    name, _, domain = host.partition(".")
+    base = name.rsplit("-", 1)[0] if "-" in name else name
+    peer = f"{base}-{process_id}"
+    if domain:
+        peer = f"{peer}.{domain}"
+    return f"{peer}:{port}"
 
-    if config is None:
-        config = resolve_config()
-    if _initialized:
-        return config
-    if max_attempts is None:
-        max_attempts = int(os.environ.get("DPX_RENDEZVOUS_RETRIES", "3")) + 1
+
+def _default_probe(address: str, timeout: float = 2.0) -> bool:
+    """Liveness probe for one peer address (host:port).
+
+    A host counts as ALIVE when its kernel answers the TCP handshake —
+    including ``ConnectionRefusedError``, because non-coordinator hosts
+    do not listen on the rendezvous port; refused still proves the host
+    exists and is reachable. DNS failure (``socket.gaierror``: a
+    rescheduled-away pod loses its headless-service record), timeout,
+    and unreachable-network errors count as DEAD.
+    """
+    host, _, port = address.rpartition(":")
+    try:
+        socket.create_connection((host, int(port)), timeout=timeout).close()
+        return True
+    except ConnectionRefusedError:
+        return True
+    except OSError:
+        return False
+
+
+def compute_survivor_config(
+    config: DistributedConfig, responsive: list
+) -> DistributedConfig:
+    """Shrunken topology over the responsive process ids.
+
+    Survivors are renumbered densely in original-rank order (ranks must
+    be 0..n-1 for ``jax.distributed.initialize``) and the lowest
+    surviving original rank becomes the coordinator. Pure function —
+    unit-testable without sockets.
+    """
+    survivors = sorted(set(responsive) | {config.process_id})
+    if config.process_id not in survivors:  # defensive; union above
+        raise RuntimeError("self must be a survivor")
+    new_id = survivors.index(config.process_id)
+    coordinator = peer_address(config, survivors[0])
+    return DistributedConfig(
+        num_processes=len(survivors),
+        process_id=new_id,
+        coordinator_address=coordinator,
+    )
+
+
+def shrink_to_survivors(
+    config: DistributedConfig, probe=None
+) -> DistributedConfig:
+    """Probe every peer and return the reduced world of responsive hosts.
+
+    Every surviving host runs the SAME probe sweep against the same peer
+    list, so they all derive the same survivor set and agree on the new
+    coordinator and dense renumbering without communicating.
+    """
+    probe = probe if probe is not None else _default_probe
+    responsive = [config.process_id]
+    for k in range(config.num_processes):
+        if k == config.process_id:
+            continue
+        address = peer_address(config, k)
+        alive = probe(address)
+        logger.info(
+            "Elastic probe: process %d (%s) %s",
+            k, address, "alive" if alive else "unresponsive",
+        )
+        if alive:
+            responsive.append(k)
+    return compute_survivor_config(config, responsive)
+
+
+def _attempt_join(config: DistributedConfig, max_attempts: int) -> None:
+    """One bounded-retry rendezvous against a FIXED topology."""
+    from distributed_pytorch_example_tpu.robustness import chaos, retry
 
     def _join():
         # deterministic fault injection (no-op without a chaos plan); sits
@@ -169,6 +233,70 @@ def initialize(
         retry_on=(RuntimeError, OSError, ConnectionError),
         describe="coordinator rendezvous",
     )
+
+
+def initialize(
+    config: Optional[DistributedConfig] = None,
+    max_attempts: Optional[int] = None,
+    probe=None,
+) -> DistributedConfig:
+    """Join the multi-host job (reference ``setup_distributed``, train.py:70-82).
+
+    No-op for single-process topologies; idempotent. Returns the config
+    actually joined — callers MUST use it (not their own copy): under
+    elastic mode it may describe a smaller world.
+
+    The coordinator rendezvous is retried with bounded exponential backoff
+    (graft-armor): hosts of a preempted-and-rescheduled job come up at
+    different times, and the first connect to a coordinator that is not
+    listening yet is a TRANSIENT failure, not a config error. Knobs:
+    ``max_attempts`` (default ``$DPX_RENDEZVOUS_RETRIES`` + 1 = 4 total)
+    and ``$DPX_RENDEZVOUS_BACKOFF`` (base delay seconds, default 1.0).
+
+    Shrink-to-survivors (graft-elastic, ``DPX_ELASTIC=1``): when every
+    rendezvous attempt is exhausted — the full world never assembled,
+    typically because a preempted slice is gone for good — each
+    surviving host probes its peers (:func:`shrink_to_survivors`),
+    derives the identical reduced world, and retries the rendezvous at
+    the smaller size instead of hard-failing. The caller then rebuilds
+    the mesh via the normal ``make_mesh`` + ``Partitioner`` factories
+    and resumes from the last intact checkpoint; the format-3 mesh
+    stamp + reshard-on-load (``train/checkpoint.py``) absorb the
+    topology change. Without the env gate the exhaustion error
+    propagates unchanged (r10 behavior).
+    """
+    global _initialized
+    # function-local import: robustness must stay importable before the
+    # runtime package finishes initializing (no cycle at module load)
+    from distributed_pytorch_example_tpu.robustness import elastic
+
+    if config is None:
+        config = resolve_config()
+    if _initialized:
+        return config
+    if max_attempts is None:
+        max_attempts = int(os.environ.get("DPX_RENDEZVOUS_RETRIES", "3")) + 1
+
+    try:
+        _attempt_join(config, max_attempts)
+    except Exception as err:
+        if not (elastic.elastic_enabled() and config.is_distributed):
+            raise
+        shrunk = shrink_to_survivors(config, probe=probe)
+        if shrunk.num_processes >= config.num_processes:
+            # everyone answered the probe: the failure is not a lost
+            # slice (bad port, config error, ...) — shrinking would
+            # deadlock the same full world at a new size
+            raise
+        logger.warning(
+            "Rendezvous exhausted at world size %d (%s); %s=1: shrinking "
+            "to %d survivor(s), new process_id=%d, coordinator=%s",
+            config.num_processes, err, elastic.ELASTIC_ENV,
+            shrunk.num_processes, shrunk.process_id,
+            shrunk.coordinator_address,
+        )
+        _attempt_join(shrunk, max_attempts)
+        config = shrunk
     _initialized = True
     return config
 

@@ -71,6 +71,16 @@ Integrity, retention, and self-healing fallback (graft-armor, r10):
   so the fault matrix can inject transient ``OSError`` / mid-save
   SIGKILL deterministically; without a plan installed the hooks are
   no-ops.
+
+Mesh-shape-agnostic resume (graft-elastic, r11): every save — both
+formats — is stamped with a format-3 ``mesh_manifest`` (mesh axis
+names/sizes, per-leaf PartitionSpecs, ZeRO-1 scatter dims; see
+``robustness/elastic.py``). Loaders validate the stamp against the
+target mesh (cross-mesh restores are logged; ``DPX_ELASTIC=1`` resume
+from an UNSTAMPED pre-format-3 checkpoint raises
+``MissingMeshManifestError``), the sharded loader streams reassembly
+per leaf to bound host memory, and the fallback walk-back prefers
+same-mesh ancestors unless elastic mode asks for newest-intact-wins.
 """
 
 from __future__ import annotations
@@ -88,6 +98,7 @@ import numpy as np
 from flax import serialization
 
 from distributed_pytorch_example_tpu.robustness import chaos
+from distributed_pytorch_example_tpu.robustness import elastic
 from distributed_pytorch_example_tpu.robustness.integrity import (
     CheckpointCorruptError,
     read_verified,
@@ -233,7 +244,7 @@ def _gathered_history_paths(path: str) -> List[str]:
 
 def _write_payload(
     path: str, host_state, epoch: int, loss: float, extra,
-    retain: int = DEFAULT_RETAIN,
+    retain: int = DEFAULT_RETAIN, mesh_manifest: Optional[dict] = None,
 ) -> None:
     payload = {
         "epoch": epoch,
@@ -241,6 +252,10 @@ def _write_payload(
         "state": serialization.to_state_dict(host_state),
         "extra": extra or {},
     }
+    if mesh_manifest is not None:
+        # format-3 mesh stamp (graft-elastic): what topology this state
+        # was sharded under at save time — validate_resume reads it back
+        payload[elastic.MANIFEST_KEY] = mesh_manifest
     blob = seal(serialization.msgpack_serialize(payload))
     if retain > 0:
         # retention trail: the sealed blob lands in {path}.history/ first,
@@ -354,6 +369,7 @@ def _begin_sharded_save(path: str, version: str) -> None:
 def _save_sharded(
     path: str, state: Any, epoch: int, loss: float, extra,
     retain: int = DEFAULT_RETAIN, version: Optional[str] = None,
+    mesh_manifest: Optional[dict] = None,
 ) -> None:
     """Collective-free sharded save; every process writes only its shards.
 
@@ -384,6 +400,18 @@ def _save_sharded(
             host_leaves[p] = np.asarray(leaf)
             continue
         meta[p] = {"shape": list(leaf.shape), "dtype": str(leaf.dtype)}
+        try:
+            # global distinct-chunk count (replica-0 shards across ALL
+            # processes): lets the loader stream — device_put each leaf
+            # the moment its last chunk lands and free the host buffer,
+            # instead of holding the whole state on the host at once
+            index_map = leaf.sharding.devices_indices_map(leaf.shape)
+            meta[p]["chunks"] = len({
+                tuple((s.start or 0, s.stop) for s in idx)
+                for idx in index_map.values()
+            })
+        except Exception:  # non-fatal: loader falls back to bulk mode
+            pass
         for shard in leaf.addressable_shards:
             if shard.replica_id != 0:
                 continue  # exactly one device globally owns replica 0
@@ -430,6 +458,8 @@ def _save_sharded(
         "leaves": meta,
         "host_leaves": host_leaves,
     }
+    if mesh_manifest is not None:
+        manifest[elastic.MANIFEST_KEY] = mesh_manifest
     _atomic_write(
         os.path.join(step_dir, "manifest.msgpack"),
         seal(serialization.msgpack_serialize(manifest)),
@@ -484,9 +514,18 @@ def _sharded_version_dirs(path: str) -> List[str]:
 
 
 def _load_sharded_version(
-    step_dir: str, state_template: Any, shardings
+    step_dir: str, state_template: Any, shardings,
+    target_axes: Optional[dict] = None,
 ) -> Tuple[Any, int, dict]:
-    """Restore one sharded version dir (CRC-verified manifest + shards)."""
+    """Restore one sharded version dir (CRC-verified manifest + shards).
+
+    Reassembly STREAMS per leaf when the manifest carries global chunk
+    counts (format 3): as soon as a leaf's last chunk is filled it is
+    device_put onto its target sharding and the host buffer freed, so
+    peak host memory is bounded by the largest leaf plus whatever is
+    still partially assembled — not the whole state. Manifests without
+    chunk counts (r10 and older) fall back to whole-state assembly.
+    """
     manifest = serialization.msgpack_restore(
         read_verified(os.path.join(step_dir, "manifest.msgpack"))
     )
@@ -494,23 +533,9 @@ def _load_sharded_version(
         raise CheckpointCorruptError(
             f"{step_dir}: manifest is not a checkpoint manifest"
         )
-
-    buffers = {
-        p: np.empty(tuple(m["shape"]), np.dtype(m["dtype"]))
-        for p, m in manifest["leaves"].items()
-    }
-    for i in range(int(manifest["nproc"])):
-        chunks = serialization.msgpack_restore(
-            read_verified(os.path.join(step_dir, f"shard_{i:05d}.msgpack"))
-        )
-        for p, entries in chunks.items():
-            for entry in entries:
-                data = np.asarray(entry["data"])
-                idx = tuple(
-                    slice(int(s), int(s) + d)
-                    for s, d in zip(entry["start"], data.shape)
-                )
-                buffers[p][idx] = data
+    elastic.validate_resume(
+        manifest.get(elastic.MANIFEST_KEY), target_axes, step_dir
+    )
 
     if shardings is None:
         shardings = jax.tree_util.tree_map(
@@ -523,22 +548,63 @@ def _load_sharded_version(
     flat_s = jax.tree_util.tree_leaves(
         shardings, is_leaf=lambda x: x is None
     )
-    restored = []
-    for (key_path, tmpl), sh in zip(flat_t, flat_s):
-        p = _path_str(key_path)
-        if p in buffers:
-            val = buffers[p]
-        elif p in manifest["host_leaves"]:
-            val = manifest["host_leaves"][p]
-        else:
-            raise KeyError(f"checkpoint is missing leaf {p!r}")
+    by_path = {
+        _path_str(key_path): (tmpl, sh)
+        for (key_path, tmpl), sh in zip(flat_t, flat_s)
+    }
+
+    def place(p, val):
+        tmpl, sh = by_path[p]
         if isinstance(tmpl, jax.Array) and jnp.issubdtype(
             tmpl.dtype, jax.dtypes.prng_key
         ):
             val = jax.random.wrap_key_data(jnp.asarray(val))
-        restored.append(
-            jax.device_put(val, sh) if sh is not None else jnp.asarray(val)
+        return jax.device_put(val, sh) if sh is not None else jnp.asarray(val)
+
+    leaves_meta = manifest["leaves"]
+    buffers: dict = {}
+    ready: dict = {}
+    remaining = {
+        p: int(m["chunks"])
+        for p, m in leaves_meta.items()
+        if isinstance(m, dict) and m.get("chunks")
+    }
+    for i in range(int(manifest["nproc"])):
+        chunks = serialization.msgpack_restore(
+            read_verified(os.path.join(step_dir, f"shard_{i:05d}.msgpack"))
         )
+        for p, entries in chunks.items():
+            m = leaves_meta.get(p)
+            if m is None:
+                continue  # stale leaf from an older tree; final loop errors
+            buf = buffers.get(p)
+            if buf is None:
+                buf = buffers[p] = np.empty(
+                    tuple(m["shape"]), np.dtype(m["dtype"])
+                )
+            for entry in entries:
+                data = np.asarray(entry["data"])
+                idx = tuple(
+                    slice(int(s), int(s) + d)
+                    for s, d in zip(entry["start"], data.shape)
+                )
+                buf[idx] = data
+            if p in remaining and p in by_path:
+                remaining[p] -= len(entries)
+                if remaining[p] <= 0:
+                    ready[p] = place(p, buffers.pop(p))
+
+    restored = []
+    for (key_path, tmpl), sh in zip(flat_t, flat_s):
+        p = _path_str(key_path)
+        if p in ready:
+            restored.append(ready.pop(p))
+        elif p in buffers:
+            restored.append(place(p, buffers.pop(p)))
+        elif p in manifest["host_leaves"]:
+            restored.append(place(p, manifest["host_leaves"][p]))
+        else:
+            raise KeyError(f"checkpoint is missing leaf {p!r}")
     state = jax.tree_util.tree_unflatten(treedef, restored)
     logger.info(
         "Sharded checkpoint loaded from %s, epoch %s",
@@ -548,7 +614,8 @@ def _load_sharded_version(
 
 
 def _load_gathered_file(
-    path: str, state_template: Any, shardings
+    path: str, state_template: Any, shardings,
+    target_axes: Optional[dict] = None,
 ) -> Tuple[Any, int, dict]:
     """Restore one gathered checkpoint file (CRC-verified)."""
     payload = serialization.msgpack_restore(read_verified(path))
@@ -556,6 +623,9 @@ def _load_gathered_file(
         raise CheckpointCorruptError(
             f"{path}: not a gathered checkpoint payload"
         )
+    elastic.validate_resume(
+        payload.get(elastic.MANIFEST_KEY), target_axes, path
+    )
     state = serialization.from_state_dict(state_template, payload["state"])
 
     if shardings is None:
@@ -584,6 +654,59 @@ def _is_sharded(path: str) -> bool:
         return False
 
 
+def _peek_stamped_axes(desc: str) -> Optional[dict]:
+    """Canonical stamped mesh axes of one fallback candidate, or None.
+
+    Cheap for sharded version dirs (manifest only); the gathered peek
+    deserializes the payload, acceptable because peeking only happens on
+    the rare fallback path. Unreadable/unstamped candidates return None
+    (sorted after known-same-mesh ones).
+    """
+    try:
+        artifact = (
+            os.path.join(desc, "manifest.msgpack")
+            if os.path.isdir(desc)
+            else desc
+        )
+        blob = serialization.msgpack_restore(read_verified(artifact))
+        stamp = blob.get(elastic.MANIFEST_KEY) if isinstance(blob, dict) else None
+        if isinstance(stamp, dict):
+            return elastic.canonical_axes(stamp.get("axes", {}))
+    except Exception:
+        return None
+    return None
+
+
+def _order_fallback_candidates(
+    queue: List[Tuple[str, Callable]], target_axes: Optional[dict]
+) -> List[Tuple[str, Callable]]:
+    """Order surviving fallback candidates per the elastic mode.
+
+    ``DPX_ELASTIC=1``: newest intact wins regardless of stamped mesh —
+    keep the age order. Otherwise prefer candidates stamped with the
+    TARGET mesh shape (stable partition, age order within each bucket):
+    without an explicit elastic opt-in, an older same-mesh ancestor is
+    the conservative restore.
+    """
+    target = elastic.canonical_axes(target_axes)
+    if elastic.elastic_enabled() or target is None:
+        return queue
+    same_mesh: List[Tuple[str, Callable]] = []
+    other: List[Tuple[str, Callable]] = []
+    for cand in queue:
+        (same_mesh if _peek_stamped_axes(cand[0]) == target else other).append(
+            cand
+        )
+    if same_mesh and other:
+        logger.info(
+            "Checkpoint fallback ordering: preferring %d same-mesh "
+            "ancestor(s) over %d cross-mesh one(s) (set %s=1 for "
+            "newest-intact-wins)",
+            len(same_mesh), len(other), elastic.ELASTIC_ENV,
+        )
+    return same_mesh + other
+
+
 def save_checkpoint(
     path: str,
     state: Any,
@@ -604,15 +727,21 @@ def save_checkpoint(
     only-the-live-checkpoint behavior.
     """
     version = _version(epoch, (extra or {}).get("batch_in_epoch"))
+    # format-3 mesh stamp (graft-elastic): derived from the live state's
+    # NamedShardings on the MAIN thread — an async snapshot preserves
+    # shardings, but stamping here keeps the manifest identical for the
+    # sync and async paths
+    stamp = elastic.mesh_manifest(state)
     write = (
         (lambda snap: _save_sharded(
-            path, snap, epoch, loss, extra, retain=retain, version=version
+            path, snap, epoch, loss, extra, retain=retain, version=version,
+            mesh_manifest=stamp,
         ))
         if sharded
         else (
             lambda snap: _write_payload(
                 path, _gather_to_host(snap), epoch, loss, extra,
-                retain=retain,
+                retain=retain, mesh_manifest=stamp,
             )
         )
     )
@@ -633,13 +762,17 @@ def save_checkpoint(
         return
     if sharded:
         _save_sharded(
-            path, state, epoch, loss, extra, retain=retain, version=version
+            path, state, epoch, loss, extra, retain=retain, version=version,
+            mesh_manifest=stamp,
         )
         return
     host_state = _gather_to_host(state)
     if jax.process_index() != 0:
         return
-    _write_payload(path, host_state, epoch, loss, extra, retain=retain)
+    _write_payload(
+        path, host_state, epoch, loss, extra, retain=retain,
+        mesh_manifest=stamp,
+    )
 
 
 def load_checkpoint(
@@ -667,7 +800,19 @@ def load_checkpoint(
     :class:`CheckpointCorruptError` listing every attempt only when no
     candidate restores. ``fallback=False`` restores the strict pre-r10
     behavior (first failure propagates).
+
+    Elastic fallback ordering (graft-elastic): the newest candidate is
+    always tried first. When it fails AND ``DPX_ELASTIC`` is unset, the
+    remaining ancestors are reordered so intact SAME-mesh checkpoints
+    (per their format-3 stamp) are preferred over cross-mesh ones — the
+    conservative choice when nobody asked for a topology change. Under
+    ``DPX_ELASTIC=1`` the newest intact checkpoint wins regardless of
+    its stamped mesh shape (minimum work lost; the reshard-on-load path
+    absorbs the shape change).
     """
+    target_axes = elastic.tree_mesh_axes(shardings)
+    if target_axes is None:
+        target_axes = elastic.tree_mesh_axes(state_template)
     candidates: List[Tuple[str, Callable[[], Tuple[Any, int, dict]]]] = []
 
     def add_sharded_candidates(primary_first: bool) -> None:
@@ -676,7 +821,7 @@ def load_checkpoint(
             candidates.append((
                 pointed,
                 lambda d=pointed: _load_sharded_version(
-                    d, state_template, shardings
+                    d, state_template, shardings, target_axes
                 ),
             ))
         for d in _sharded_version_dirs(path):
@@ -687,7 +832,7 @@ def load_checkpoint(
             candidates.append((
                 d,
                 lambda d=d: _load_sharded_version(
-                    d, state_template, shardings
+                    d, state_template, shardings, target_axes
                 ),
             ))
 
@@ -696,7 +841,9 @@ def load_checkpoint(
     else:
         candidates.append((
             path,
-            lambda: _load_gathered_file(path, state_template, shardings),
+            lambda: _load_gathered_file(
+                path, state_template, shardings, target_axes
+            ),
         ))
         for p in _gathered_history_paths(path):
             try:
@@ -706,7 +853,9 @@ def load_checkpoint(
                 pass
             candidates.append((
                 p,
-                lambda p=p: _load_gathered_file(p, state_template, shardings),
+                lambda p=p: _load_gathered_file(
+                    p, state_template, shardings, target_axes
+                ),
             ))
         # a bit-flipped pointer file no longer matches SHARDED_MAGIC and
         # parses as (corrupt) gathered; intact version dirs still restore
@@ -718,9 +867,18 @@ def load_checkpoint(
         raise FileNotFoundError(f"no checkpoint candidates at {path}")
 
     skipped: List[Tuple[str, str]] = []
-    for desc, thunk in candidates:
+    queue = list(candidates)
+    reordered = False
+    while queue:
+        desc, thunk = queue.pop(0)
         try:
             state, epoch, extra = thunk()
+        except elastic.MissingMeshManifestError:
+            # a config error, not corruption: every unstamped ancestor
+            # would raise the same, and silently restoring an OLDER one
+            # under elastic mode hides that the resume contract is unmet —
+            # surface the clear remediation message instead
+            raise
         except Exception as err:
             if not fallback:
                 raise
@@ -730,6 +888,9 @@ def load_checkpoint(
                 "Checkpoint candidate %s unusable (%s); trying the "
                 "next-newest ancestor", desc, reason,
             )
+            if not reordered and queue:
+                reordered = True  # one reorder per load, fallback-only
+                queue = _order_fallback_candidates(queue, target_axes)
             continue
         if skipped:
             logger.warning(

@@ -371,6 +371,11 @@ class Trainer:
             # a FAILED background save surfaces here, within one step of
             # the fault, instead of minutes later at fit's final wait()
             self._saver.check()
+            # kill-a-slice injection site (graft-elastic): a "kill" fault
+            # at="step" SIGKILLs on the nth step BOUNDARY — the in-flight
+            # step finished, saves for it may be mid-flight — modeling a
+            # preempted slice; no-op without a chaos plan
+            chaos.crash_point("step")
             if scope is not None:
                 # rate-limited clock tick + (at boundaries) the one-fetch
                 # health check, straggler exchange, and per-N-step record.

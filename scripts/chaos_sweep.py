@@ -32,6 +32,12 @@ matrix runs under ``-m slow``):
                         on the previous intact checkpoint.
 - ``sigint``            Subprocess interrupted: checkpoint after the
                         in-flight step, exit 130.
+- ``kill-slice`` *      Preempted slice (graft-elastic): a dp8 run is
+                        SIGKILLed at a step boundary, the job shrinks
+                        to the 4 surviving devices and resumes from the
+                        last intact checkpoint under ``DPX_ELASTIC=1``;
+                        the post-resume loss trajectory must match an
+                        uninterrupted dp4 run batch-for-batch.
 
 Usage:
   python scripts/chaos_sweep.py [--fast] [--scenarios a,b,...]
@@ -51,7 +57,10 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
-FAST = ("nan-skip", "corrupt-latest", "io-flake", "rendezvous-flake")
+FAST = (
+    "nan-skip", "corrupt-latest", "io-flake", "rendezvous-flake",
+    "kill-slice",
+)
 SLOW = (
     "inf-skip", "budget-rollback", "truncate-shard", "torn-save-kill",
     "sigint",
@@ -405,6 +414,72 @@ def scenario_sigint() -> dict:
     }
 
 
+def scenario_kill_slice() -> dict:
+    """Kill-a-slice (graft-elastic): dp8 run SIGKILLed at a step boundary
+    shrinks to the 4 surviving devices; the elastic resume's post-resume
+    loss trajectory must match an uninterrupted dp4 run batch-for-batch
+    (same loss tolerance tests/test_zero1.py pins for flip-resume).
+
+    The equivalence holds because the global batch (and therefore the
+    math) is mesh-shape-independent: the dp8 steps before the kill equal
+    the dp4 control's steps modulo float reduction order, the sampler
+    permutation is a pure function of (seed, epoch), and the step rng
+    folds the restored state.step — so after reshard-on-load the two
+    runs walk the same trajectory.
+    """
+    import re
+    import tempfile
+
+    from distributed_pytorch_example_tpu.robustness import chaos
+
+    loss_re = re.compile(r"Epoch (\d+), Batch (\d+)/\d+, Loss: ([0-9.]+)")
+
+    def losses(stderr: str) -> dict:
+        return {
+            (int(m.group(1)), int(m.group(2))): float(m.group(3))
+            for m in loss_re.finditer(stderr)
+        }
+
+    def run(phase: str, td: str, env: dict):
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             phase, "--dir", td],
+            env=env, capture_output=True, text=True, cwd=REPO_ROOT,
+            timeout=600,
+        )
+
+    with tempfile.TemporaryDirectory() as td:
+        # 4 steps/epoch; the 5th step BOUNDARY is epoch 1 batch 0, so the
+        # kill lands mid-epoch with intact epoch-0 saves behind it
+        plan = chaos.ChaosPlan(faults=[
+            chaos.Fault("kill", at="step", nth=5)
+        ])
+        crash = run("elastic-train", td, _child_env(plan.to_json()))
+        killed = crash.returncode == -signal.SIGKILL
+        resume_env = _child_env()
+        resume_env["DPX_ELASTIC"] = "1"
+        resume = run("elastic-resume", td, resume_env)
+        control = run("elastic-control", td, _child_env())
+        got, want = losses(resume.stderr), losses(control.stderr)
+    common = sorted(set(got) & set(want))
+    max_diff = max(
+        (abs(got[k] - want[k]) for k in common), default=None
+    )
+    tol = 1e-3 + 1e-4  # pinned flip-resume loss tolerance + %.4f rounding
+    return {
+        "ok": (
+            killed and resume.returncode == 0 and control.returncode == 0
+            and len(common) >= 4 and max_diff is not None
+            and max_diff <= tol
+        ),
+        "action": "shrink-to-survivors-resume",
+        "killed": killed,
+        "resume_from": list(min(got)) if got else None,
+        "resumed_batches": len(common),
+        "max_loss_diff": max_diff,
+    }
+
+
 SCENARIOS = {
     "nan-skip": lambda: scenario_poison_skip("nan-batch"),
     "inf-skip": lambda: scenario_poison_skip("inf-batch"),
@@ -415,6 +490,7 @@ SCENARIOS = {
     "rendezvous-flake": scenario_rendezvous_flake,
     "torn-save-kill": scenario_torn_save_kill,
     "sigint": scenario_sigint,
+    "kill-slice": scenario_kill_slice,
 }
 assert set(SCENARIOS) == set(ALL)
 
@@ -466,6 +542,32 @@ def _run_child(phase: str, ckpt_dir: str) -> int:
         except dpx.train.PreemptionInterrupt as e:
             return e.exit_code
         return 1  # ran to completion without the signal: FAIL
+    if phase in ("elastic-train", "elastic-resume", "elastic-control"):
+        import jax
+
+        from distributed_pytorch_example_tpu.train import (
+            checkpoint as ckpt_lib,
+        )
+
+        if phase == "elastic-train":
+            emesh = mesh  # the full 8-device world
+        else:
+            # the shrunken world: half the devices survived the preemption
+            emesh = dpx.runtime.make_mesh(devices=jax.devices()[:4])
+        eloader = dpx.data.DeviceLoader(_dataset(), 64, mesh=emesh, seed=0)
+        trainer = _make_trainer(
+            ckpt_dir=None if phase == "elastic-control" else ckpt_dir,
+            mesh=emesh, checkpoint_format="sharded", save_every_steps=1,
+            log_every=1,
+        )
+        if phase == "elastic-resume":
+            trainer.fit(eloader, epochs=2, resume=os.path.join(
+                ckpt_dir, ckpt_lib.LATEST_NAME
+            ))
+            return 0
+        trainer.fit(eloader, epochs=2)
+        # elastic-train must die at the kill fault; completing is a FAIL
+        return 1 if phase == "elastic-train" else 0
     raise SystemExit(f"unknown child phase {phase!r}")
 
 

@@ -1,0 +1,202 @@
+#!/usr/bin/env python3
+"""Offline checkpoint doctor for mesh-shape-agnostic resume (graft-elastic).
+
+Inspects one checkpoint — either format — WITHOUT building a mesh or
+touching devices, and prints ONE JSON line:
+
+- the format-3 ``mesh_manifest`` stamp (mesh axes, format, epoch);
+- per-artifact seal status (gathered payload / manifest + every shard
+  file): ``sealed`` (carries the CRC envelope) and ``intact`` (envelope
+  verifies);
+- when ``--target`` names a mesh shape: whether the checkpoint is
+  resumable onto it and the per-leaf reshard plan — ``keep`` (every
+  sharded axis keeps its size), ``replicate`` (unsharded leaf),
+  ``repartition-zero1`` (ZeRO-1 opt-state leaf scattered over a resized
+  ``data`` axis), ``rebalance-pipe`` (leaf stacked over a resized
+  ``pipe`` axis), or ``reshard`` (any other re-slice).
+
+Usage:
+  python scripts/reshard_check.py <ckpt> [--target data=4,tensor=2]
+
+Exit code 0 iff every artifact is intact (and, with ``--target``, the
+checkpoint is resumable onto it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+# no device work happens here, but the axon sitecustomize would still try
+# to bring up the TPU platform on first jax import (flax pulls jax in)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from flax import serialization  # noqa: E402
+
+from distributed_pytorch_example_tpu.robustness import elastic  # noqa: E402
+from distributed_pytorch_example_tpu.robustness.integrity import (  # noqa: E402
+    is_sealed,
+    unseal,
+)
+
+_OPT_STATE_RE = re.compile(r"(^|/)opt_state(/|$)")
+
+
+def _inspect_artifact(path: str) -> dict:
+    """Seal/intact status plus the verified body (None when corrupt)."""
+    name = os.path.basename(path)
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as err:
+        return {"name": name, "sealed": False, "intact": False,
+                "error": str(err), "body": None}
+    sealed = is_sealed(data)
+    try:
+        body = unseal(data, source=path)
+        return {"name": name, "sealed": sealed, "intact": True, "body": body}
+    except Exception as err:
+        return {"name": name, "sealed": sealed, "intact": False,
+                "error": str(err), "body": None}
+
+
+def parse_target(text: str) -> dict:
+    """``data=4,tensor=2`` → {"data": 4, "tensor": 2}."""
+    axes = {}
+    for part in text.split(","):
+        if not part.strip():
+            continue
+        name, _, size = part.partition("=")
+        axes[name.strip()] = int(size)
+    return axes
+
+
+def leaf_plan(
+    path: str, entries, stamped: dict, target: dict
+) -> str:
+    """Reshard action for one leaf's stamped PartitionSpec entries."""
+    sharded_axes = [a for e in entries for a in elastic._entry_axes(e)]
+    if not sharded_axes:
+        return "replicate"
+    resized = [
+        a for a in sharded_axes
+        if int(target.get(a, 1)) != int(stamped.get(a, 1))
+    ]
+    if not resized:
+        return "keep"
+    if "data" in resized and _OPT_STATE_RE.search(path):
+        return "repartition-zero1"
+    if "pipe" in resized:
+        return "rebalance-pipe"
+    return "reshard"
+
+
+def inspect_checkpoint(path: str, target: dict | None) -> dict:
+    from distributed_pytorch_example_tpu.train import checkpoint as ckpt_lib
+
+    report: dict = {
+        "tool": "reshard_check",
+        "path": path,
+        "format": None,
+        "ok": False,
+        "manifest": None,
+        "artifacts": [],
+        "target": target or None,
+        "resumable": None,
+        "reshard_plan": None,
+    }
+    if not os.path.exists(path):
+        report["error"] = "no such checkpoint"
+        return report
+
+    stamp = None
+    version = None
+    if ckpt_lib._is_sharded(path):
+        report["format"] = "sharded"
+        step_dir = ckpt_lib._pointed_version_dir(path)
+        if step_dir is None or not os.path.isdir(step_dir):
+            report["error"] = "pointer names no committed version dir"
+            return report
+        version = os.path.basename(step_dir)
+        manifest_art = _inspect_artifact(
+            os.path.join(step_dir, "manifest.msgpack")
+        )
+        artifacts = [manifest_art]
+        blob = None
+        if manifest_art["body"] is not None:
+            blob = serialization.msgpack_restore(manifest_art["body"])
+        nproc = int(blob.get("nproc", 0)) if isinstance(blob, dict) else 0
+        for i in range(nproc):
+            artifacts.append(_inspect_artifact(
+                os.path.join(step_dir, f"shard_{i:05d}.msgpack")
+            ))
+    else:
+        report["format"] = "gathered"
+        art = _inspect_artifact(path)
+        artifacts = [art]
+        blob = (
+            serialization.msgpack_restore(art["body"])
+            if art["body"] is not None else None
+        )
+
+    report["artifacts"] = [
+        {k: v for k, v in a.items() if k != "body"} for a in artifacts
+    ]
+    intact = all(a["intact"] for a in artifacts) and blob is not None
+    if isinstance(blob, dict):
+        raw_stamp = blob.get(elastic.MANIFEST_KEY)
+        stamp = raw_stamp if isinstance(raw_stamp, dict) else None
+        report["manifest"] = {
+            "format": (
+                int(stamp["format"]) if stamp else 2 if artifacts[0]["sealed"]
+                else 1
+            ),
+            "axes": dict(stamp["axes"]) if stamp else None,
+            "epoch": int(blob.get("epoch", -1)),
+            "version": version,
+        }
+
+    if target:
+        if stamp is None:
+            # an unstamped (pre-format-3) checkpoint only resumes on the
+            # mesh it was saved under, which is unknowable offline
+            report["resumable"] = None
+        else:
+            report["resumable"] = bool(intact)
+            report["reshard_plan"] = {
+                p: {
+                    "spec": entries,
+                    "action": leaf_plan(
+                        p, entries, stamp.get("axes", {}), target
+                    ),
+                }
+                for p, entries in sorted(stamp.get("specs", {}).items())
+            }
+    report["ok"] = bool(intact and report["resumable"] is not False)
+    return report
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("ckpt", help="checkpoint path (pointer or file)")
+    parser.add_argument(
+        "--target", default=None,
+        help="target mesh shape, e.g. data=4,tensor=2",
+    )
+    args = parser.parse_args()
+    target = parse_target(args.target) if args.target else None
+    report = inspect_checkpoint(args.ckpt, target)
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

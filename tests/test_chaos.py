@@ -541,8 +541,12 @@ def test_chaos_sweep_fast_subset_green():
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert [r["scenario"] for r in lines] == [
         "nan-skip", "corrupt-latest", "io-flake", "rendezvous-flake",
+        "kill-slice",
     ]
     assert all(r["ok"] for r in lines), lines
+    kill_slice = lines[-1]
+    assert kill_slice["action"] == "shrink-to-survivors-resume"
+    assert kill_slice["max_loss_diff"] <= 1e-3 + 1e-4
 
 
 @pytest.mark.slow

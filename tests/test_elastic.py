@@ -1,0 +1,568 @@
+"""graft-elastic: mesh-shape-agnostic resume + shrink-to-survivors.
+
+Three surfaces, one contract:
+
+- the cross-mesh resume EQUIVALENCE MATRIX: a checkpoint saved at one
+  (data, tensor, pipe) shape resumes onto a different shape and the
+  post-resume loss trajectory matches an uninterrupted run within the
+  tolerances tests/test_zero1.py pins for gradient-sync equivalence
+  (5e-4 params / 1e-3 loss) — the global batch is mesh-shape-independent,
+  so the math only differs by floating-point reduction order;
+- the format-3 ``mesh_manifest`` stamp and its backward-compat contract:
+  unstamped r10-era checkpoints still load on the SAME mesh, elastic
+  resume from them raises :class:`MissingMeshManifestError`, and the
+  corrupt-fallback walk-back prefers same-mesh ancestors exactly when
+  ``DPX_ELASTIC`` is unset;
+- the shrink-to-survivors launcher path (``runtime/distributed.py``):
+  pure survivor-set derivation, probe semantics, and the env-gated
+  shrink retry inside ``initialize`` — all unit-tested with fake probes
+  (the end-to-end kill-a-slice run lives in scripts/chaos_sweep.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+from distributed_pytorch_example_tpu.parallel.api import data_parallel
+from distributed_pytorch_example_tpu.parallel.partition import (
+    transformer_partitioner,
+)
+from distributed_pytorch_example_tpu.robustness import chaos, elastic
+from distributed_pytorch_example_tpu.runtime import (
+    MeshSpec,
+    distributed,
+    make_mesh,
+)
+from distributed_pytorch_example_tpu.runtime.distributed import (
+    DistributedConfig,
+)
+from distributed_pytorch_example_tpu.train import checkpoint as ckpt_lib
+from distributed_pytorch_example_tpu.train.step import (
+    build_train_step,
+    init_state,
+)
+from distributed_pytorch_example_tpu.train.tasks import CausalLMTask
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the bars tests/test_zero1.py pins for reduction-order equivalence
+TOL_PARAMS = 5e-4
+TOL_LOSS = 1e-3
+PRE_STEPS = 2   # steps before the save on the source mesh
+K_RESUME = 3    # post-resume steps compared against the control
+
+_TOKENS = np.random.default_rng(0).integers(0, 64, (16, 16)).astype(np.int32)
+
+
+def _tiny_model():
+    return GPT2(
+        vocab_size=64, max_len=32, model_dim=32, num_layers=1,
+        num_heads=2, mlp_dim=64, logits_mode="hidden",
+    )
+
+
+def _copy(state):
+    # compiled steps donate their input state; never feed a cached state
+    # object into a step twice
+    return jax.tree_util.tree_map(
+        lambda x: x.copy() if isinstance(x, jax.Array) else x, state
+    )
+
+
+def _max_diff(a, b):
+    # via host: the two trees may live on different device subsets
+    diffs = jax.tree_util.tree_map(
+        lambda x, y: float(np.max(np.abs(
+            np.asarray(x, np.float32) - np.asarray(y, np.float32)
+        ))),
+        a, b,
+    )
+    return max(jax.tree_util.tree_leaves(diffs))
+
+
+_CFG_CACHE = {}
+
+
+def _config(name):
+    """(mesh, batch, state0, shardings, step) for one named mesh shape.
+
+    All five shapes host the SAME tiny GPT-2, so any config's checkpoint
+    restores into any other's template. Memoized: each entry costs one
+    jit compile on the one-core build box.
+    """
+    if name in _CFG_CACHE:
+        return _CFG_CACHE[name]
+    model, task, opt = _tiny_model(), CausalLMTask(), optax.adam(1e-3)
+    if name == "dp8":
+        mesh = make_mesh()
+        part = data_parallel(mesh)
+    elif name == "dp4":
+        mesh = make_mesh(devices=jax.devices()[:4])
+        part = data_parallel(mesh)
+    elif name == "dp8z":
+        mesh = make_mesh()
+        part = data_parallel(
+            mesh, dp_shard_opt_state=True, opt_shard_min_size=1
+        )
+    elif name == "dp2tp2":
+        mesh = make_mesh(
+            MeshSpec(data=2, tensor=2), devices=jax.devices()[:4]
+        )
+        part = transformer_partitioner(mesh)
+    else:
+        raise KeyError(name)
+    batch = {"tokens": jax.device_put(_TOKENS, part.batch_sharding())}
+    with mesh:
+        state0, shardings = init_state(
+            model, opt, batch["tokens"], jax.random.key(0), part
+        )
+        step = build_train_step(
+            model, task, opt, partitioner=part, grad_accum_steps=1
+        )
+    if name != "dp8":
+        # jax RNG values depend on the sharding the init jit runs under
+        # (the dim-0 "tensor"-sharded leaves draw different bits), so a
+        # per-config init would diverge at step 0. Re-slice ONE canonical
+        # init onto this config's layout instead — exactly what a
+        # checkpoint restore does, which is the surface under test.
+        state0 = jax.device_put(_config("dp8")[2], shardings)
+    _CFG_CACHE[name] = (mesh, batch, state0, shardings, step)
+    return _CFG_CACHE[name]
+
+
+_TRAJ_CACHE = {}
+
+
+def _traj(name, n, start=None):
+    """(state after n steps, loss trajectory) for one config.
+
+    ``start=None`` runs from the config's init (memoized); passing a
+    restored state runs the post-resume continuation (not cached).
+    """
+    key = (name, n)
+    if start is None and key in _TRAJ_CACHE:
+        return _TRAJ_CACHE[key]
+    mesh, batch, state0, _, step = _config(name)
+    state = _copy(state0 if start is None else start)
+    losses = []
+    with mesh:
+        for _ in range(n):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+    if start is None:
+        _TRAJ_CACHE[key] = (state, losses)
+    return state, losses
+
+
+# ---------------------------------------------------------------------------
+# cross-mesh resume equivalence matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "src,tgt,fmt",
+    [
+        ("dp8", "dp4", "gathered"),   # shrink, both formats
+        ("dp8", "dp4", "sharded"),
+        ("dp4", "dp8", "gathered"),   # grow
+        ("dp2tp2", "dp4", "sharded"),  # TP regather into pure DP
+        ("dp8z", "dp4", "sharded"),   # ZeRO-1 -> replicated across shapes
+        ("dp4", "dp8z", "sharded"),   # replicated -> ZeRO-1 across shapes
+    ],
+)
+def test_cross_mesh_resume_matches_uninterrupted(
+    tmp_path, devices, src, tgt, fmt
+):
+    """Save at ``src``'s shape, restore onto ``tgt``'s, continue K steps:
+    the post-resume trajectory matches an uninterrupted control run."""
+    src_state, _ = _traj(src, PRE_STEPS)
+    path = str(tmp_path / "ck")
+    ckpt_lib.save_checkpoint(
+        path, src_state, 1, 0.0, {}, sharded=(fmt == "sharded")
+    )
+
+    _, _, state0_t, shardings_t, _ = _config(tgt)
+    restored, epoch, _ = ckpt_lib.load_checkpoint(path, state0_t, shardings_t)
+    assert epoch == 1
+    # restored leaves landed on the TARGET layout, not the stamped one
+    leaf_r = jax.tree_util.tree_leaves(restored.params)[0]
+    leaf_t = jax.tree_util.tree_leaves(shardings_t.params)[0]
+    assert leaf_r.sharding == leaf_t
+
+    final, losses = _traj(tgt, K_RESUME, start=restored)
+    ctrl_state, ctrl_losses = _traj("dp8", PRE_STEPS + K_RESUME)
+    for got, want in zip(losses, ctrl_losses[PRE_STEPS:]):
+        assert abs(got - want) < TOL_LOSS, (losses, ctrl_losses)
+    assert _max_diff(final.params, ctrl_state.params) < TOL_PARAMS
+
+
+def test_pipe_shrink_resume_matches_uninterrupted(tmp_path, devices):
+    """pipe=2 -> pipe=1: the pipe-stacked parameter stacks re-balance onto
+    a mesh with no pipeline span, and training continues equivalently."""
+    def mk(sched):
+        # the schedules are checkpoint-compatible (identical param trees,
+        # pinned by test_stacked.py); 1f1b refuses a pipe span of 1, so
+        # the shrunken mesh runs the same params under gpipe
+        return GPT2(
+            vocab_size=64, max_len=32, model_dim=32, num_layers=2,
+            num_heads=2, mlp_dim=64, pipe_axis="pipe", pipe_schedule=sched,
+            pipe_microbatches=4, logits_mode="hidden",
+        )
+
+    task, opt = CausalLMTask(), optax.adam(1e-3)
+
+    def build(model, mesh, canon=None):
+        part = transformer_partitioner(mesh)
+        batch = {"tokens": jax.device_put(_TOKENS, part.batch_sharding())}
+        with mesh:
+            state0, shardings = init_state(
+                model, opt, batch["tokens"], jax.random.key(0), part
+            )
+            step = build_train_step(
+                model, task, opt, partitioner=part, grad_accum_steps=1
+            )
+        if canon is not None:
+            # same canonical-init rationale as _config
+            state0 = jax.device_put(canon, shardings)
+        return mesh, batch, state0, shardings, step
+
+    def run(cfg, n, start):
+        mesh, batch, _, _, step = cfg
+        state, losses = _copy(start), []
+        with mesh:
+            for _ in range(n):
+                state, metrics = step(state, batch)
+                losses.append(float(metrics["loss"]))
+        return state, losses
+
+    src = build(mk("1f1b"), make_mesh(MeshSpec(data=4, pipe=2)))
+    tgt = build(
+        mk("gpipe"), make_mesh(MeshSpec(data=4), devices=jax.devices()[:4]),
+        canon=src[2],
+    )
+
+    # the source actually spans the pipe axis (stacked stage dim sharded)
+    q = src[2].params["decoder"]["q_kernel"]
+    assert "pipe" in str(q.sharding.spec)
+
+    try:
+        src_state, _ = run(src, PRE_STEPS, src[2])
+    except Exception as err:  # pragma: no cover - backend-dependent
+        if "PartitionId" in str(err):
+            pytest.skip(
+                "pipeline step does not SPMD-partition on this backend "
+                "(XLA 'PartitionId instruction is not supported' — the "
+                "same environmental limit the test_stacked.py pipeline "
+                "suite hits on this box)"
+            )
+        raise
+    path = str(tmp_path / "ck")
+    ckpt_lib.save_checkpoint(path, src_state, 1, 0.0, {}, sharded=True)
+
+    restored, epoch, _ = ckpt_lib.load_checkpoint(path, tgt[2], tgt[3])
+    assert epoch == 1
+    final, losses = run(tgt, K_RESUME, restored)
+    ctrl_state, ctrl_losses = run(tgt, PRE_STEPS + K_RESUME, tgt[2])
+    for got, want in zip(losses, ctrl_losses[PRE_STEPS:]):
+        assert abs(got - want) < TOL_LOSS, (losses, ctrl_losses)
+    assert _max_diff(final.params, ctrl_state.params) < TOL_PARAMS
+
+
+# ---------------------------------------------------------------------------
+# format-3 stamp + backward compat
+# ---------------------------------------------------------------------------
+
+
+def test_both_formats_carry_format3_stamp(tmp_path, devices):
+    from flax import serialization
+
+    from distributed_pytorch_example_tpu.robustness.integrity import (
+        read_verified,
+    )
+
+    state, _ = _traj("dp8z", 1)
+    g_path = str(tmp_path / "g.ckpt")
+    s_path = str(tmp_path / "s.ckpt")
+    ckpt_lib.save_checkpoint(g_path, state, 1, 0.0, sharded=False)
+    ckpt_lib.save_checkpoint(s_path, state, 1, 0.0, sharded=True)
+
+    payload = serialization.msgpack_restore(read_verified(g_path))
+    manifest = serialization.msgpack_restore(read_verified(os.path.join(
+        ckpt_lib._pointed_version_dir(s_path), "manifest.msgpack"
+    )))
+    for blob in (payload, manifest):
+        stamp = blob[elastic.MANIFEST_KEY]
+        assert int(stamp["format"]) == elastic.MANIFEST_FORMAT
+        assert elastic.canonical_axes(stamp["axes"]) == {"data": 8}
+        # ZeRO-1 scatter dims recorded for the opt-state leaves
+        assert stamp["zero1_dims"], stamp
+        assert all(
+            elastic._OPT_STATE_RE.search(p) for p in stamp["zero1_dims"]
+        )
+
+
+def test_mesh_manifest_from_live_state(devices):
+    _, _, state0, _, _ = _config("dp8z")
+    stamp = elastic.mesh_manifest(state0)
+    assert stamp["format"] == elastic.MANIFEST_FORMAT
+    assert elastic.canonical_axes(stamp["axes"]) == {"data": 8}
+    # replicated params: empty/None spec entries; sharded opt moments:
+    # a 'data' axis on the scatter dim named by zero1_dims
+    for p, dim in stamp["zero1_dims"].items():
+        assert "data" in elastic._entry_axes(stamp["specs"][p][dim])
+    # pure-host trees carry no sharding: no stamp, legacy contract
+    assert elastic.mesh_manifest({"a": 1}) is None
+    # size-1 axes never count as a topology difference
+    assert elastic.canonical_axes({"data": 4, "tensor": 1}) == {"data": 4}
+
+
+@pytest.mark.parametrize("fmt", ["gathered", "sharded"])
+def test_unstamped_checkpoint_backward_compat(
+    tmp_path, devices, monkeypatch, fmt
+):
+    """r10-era (unstamped) checkpoints: same-mesh load keeps working with
+    no env set; elastic resume refuses with the clear manifest error."""
+    _, _, state0, shardings, _ = _config("dp8")
+    path = str(tmp_path / "ck")
+    # save exactly like r10 did: no stamp at all
+    monkeypatch.setattr(elastic, "mesh_manifest", lambda state: None)
+    ckpt_lib.save_checkpoint(
+        path, state0, 1, 0.0, {}, sharded=(fmt == "sharded")
+    )
+    monkeypatch.undo()
+
+    monkeypatch.delenv(elastic.ELASTIC_ENV, raising=False)
+    restored, epoch, _ = ckpt_lib.load_checkpoint(path, state0, shardings)
+    assert epoch == 1
+
+    monkeypatch.setenv(elastic.ELASTIC_ENV, "1")
+    with pytest.raises(
+        elastic.MissingMeshManifestError, match=elastic.MANIFEST_KEY
+    ):
+        ckpt_lib.load_checkpoint(path, state0, shardings)
+
+
+def test_fallback_ordering_elastic_vs_conservative(
+    tmp_path, devices, monkeypatch
+):
+    """Corrupt newest + mixed-mesh ancestors: DPX_ELASTIC unset restores
+    the older SAME-mesh ancestor; DPX_ELASTIC=1 restores the newest
+    intact one regardless of its stamped shape."""
+    state8, _ = _traj("dp8", 1)
+    _, _, state0_4, _, _ = _config("dp4")
+    path = str(tmp_path / "ck")
+    ckpt_lib.save_checkpoint(path, state8, 1, 0.0, {}, sharded=True)   # mesh A
+    ckpt_lib.save_checkpoint(path, state0_4, 2, 0.0, {}, sharded=True)  # mesh B
+    ckpt_lib.save_checkpoint(path, state8, 3, 0.0, {}, sharded=True)   # mesh A
+    chaos.corrupt_file(os.path.join(
+        f"{path}.shards", "00000003", "shard_00000.msgpack"
+    ))
+
+    _, _, state0_8, shardings8, _ = _config("dp8")
+    monkeypatch.delenv(elastic.ELASTIC_ENV, raising=False)
+    _, epoch, _ = ckpt_lib.load_checkpoint(path, state0_8, shardings8)
+    assert epoch == 1  # same-mesh ancestor preferred over newer cross-mesh
+
+    monkeypatch.setenv(elastic.ELASTIC_ENV, "1")
+    _, epoch, _ = ckpt_lib.load_checkpoint(path, state0_8, shardings8)
+    assert epoch == 2  # newest intact wins, reshard-on-load absorbs shape
+
+
+def test_resume_gap_steps(tmp_path):
+    path = str(tmp_path / "ck")
+    shards = f"{path}.shards"
+    os.makedirs(os.path.join(shards, "00000002.00000001"))
+    os.makedirs(os.path.join(shards, "00000002.00000003"))
+    gap = elastic.resume_gap_steps(path, 2, {"batch_in_epoch": 1})
+    assert gap == 2  # two mid-epoch saves newer than the restored cursor
+    assert elastic.resume_gap_steps(path, 2, {"batch_in_epoch": 3}) == 0
+    assert elastic.resume_gap_steps(path, 1, {}) is None  # epoch boundary
+
+    g_path = str(tmp_path / "g.ckpt")
+    with open(g_path, "w") as f:
+        f.write("x")
+    assert elastic.resume_gap_steps(g_path, 1) == 0  # single artifact
+    hist = f"{g_path}.history"
+    os.makedirs(hist)
+    os.link(g_path, os.path.join(hist, "00000001.ckpt"))
+    assert elastic.resume_gap_steps(g_path, 1) == 0  # newest entry IS path
+    with open(os.path.join(hist, "00000002.ckpt"), "w") as f:
+        f.write("y")
+    assert elastic.resume_gap_steps(g_path, 1) is None  # newer torn save
+
+
+# ---------------------------------------------------------------------------
+# shrink-to-survivors (fake probes; the real kill lives in chaos_sweep)
+# ---------------------------------------------------------------------------
+
+
+def test_peer_address():
+    cfg = DistributedConfig(4, 1, "myjob-0.svc.cluster.local:29500")
+    assert distributed.peer_address(cfg, 3) == (
+        "myjob-3.svc.cluster.local:29500"
+    )
+    bare = DistributedConfig(4, 0, "node-0:29")
+    assert distributed.peer_address(bare, 2) == "node-2:29"
+    with pytest.raises(ValueError):
+        distributed.peer_address(DistributedConfig(1, 0, None), 0)
+
+
+def test_compute_survivor_config():
+    cfg = DistributedConfig(8, 5, "w-0.svc:29500")
+    shrunk = distributed.compute_survivor_config(cfg, [0, 1, 6])
+    assert shrunk.num_processes == 4
+    assert shrunk.process_id == 2  # dense renumbering in original order
+    assert shrunk.coordinator_address == "w-0.svc:29500"
+
+    # the coordinator itself was lost: lowest survivor takes over
+    cfg = DistributedConfig(8, 6, "w-0.svc:29500")
+    shrunk = distributed.compute_survivor_config(cfg, [5, 7])
+    assert shrunk.num_processes == 3
+    assert shrunk.process_id == 1
+    assert shrunk.coordinator_address == "w-5.svc:29500"
+
+
+def test_shrink_to_survivors_probes_peers():
+    cfg = DistributedConfig(4, 0, "job-0.svc:29500")
+    probed = []
+
+    def probe(address):
+        probed.append(address)
+        return "job-2" not in address
+
+    shrunk = distributed.shrink_to_survivors(cfg, probe=probe)
+    assert len(probed) == 3  # everyone but self
+    assert shrunk.num_processes == 3
+    assert shrunk.process_id == 0
+    assert shrunk.coordinator_address == "job-0.svc:29500"
+
+
+def test_initialize_shrinks_only_under_elastic(monkeypatch):
+    calls = []
+
+    def fake_join(config, max_attempts):
+        calls.append(config)
+        if config.num_processes == 4:
+            raise RuntimeError("rendezvous exhausted")
+
+    monkeypatch.setattr(distributed, "_attempt_join", fake_join)
+    monkeypatch.setattr(distributed, "_initialized", False)
+    cfg = DistributedConfig(4, 0, "job-0.svc:29500")
+    lossy_probe = lambda address: "job-3" not in address  # noqa: E731
+
+    # r10 behavior without the gate: the exhaustion error propagates
+    monkeypatch.delenv(elastic.ELASTIC_ENV, raising=False)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        distributed.initialize(cfg, probe=lossy_probe)
+
+    # elastic: shrink to the 3 survivors and join at the smaller world
+    monkeypatch.setenv(elastic.ELASTIC_ENV, "1")
+    monkeypatch.setattr(distributed, "_initialized", False)
+    joined = distributed.initialize(cfg, probe=lossy_probe)
+    assert joined.num_processes == 3
+    assert joined.process_id == 0
+    assert calls[-1].num_processes == 3
+
+    # every peer answered: a config error, not a lost slice — re-raise
+    monkeypatch.setattr(distributed, "_initialized", False)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        distributed.initialize(cfg, probe=lambda address: True)
+    monkeypatch.setattr(distributed, "_initialized", False)
+
+
+# ---------------------------------------------------------------------------
+# offline checkpoint doctor (scripts/reshard_check.py)
+# ---------------------------------------------------------------------------
+
+
+def _reshard_check_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "reshard_check", os.path.join(REPO_ROOT, "scripts/reshard_check.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_reshard_check_prints_one_json_line(tmp_path, devices):
+    """Subprocess contract: ONE JSON line on stdout, exit 0 iff intact
+    and resumable; flipped bits flip the verdict."""
+    state, _ = _traj("dp8z", 1)
+    path = str(tmp_path / "ck")
+    ckpt_lib.save_checkpoint(path, state, 1, 0.0, {}, sharded=True)
+
+    def run():
+        return subprocess.run(
+            [
+                sys.executable, os.path.join(REPO_ROOT, "scripts/reshard_check.py"),
+                path, "--target", "data=4",
+            ],
+            capture_output=True, text=True, timeout=240, cwd=REPO_ROOT,
+        )
+
+    proc = run()
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout + proc.stderr
+    report = json.loads(lines[0])
+    assert proc.returncode == 0, (report, proc.stderr)
+    assert report["ok"] is True
+    assert report["format"] == "sharded"
+    assert report["manifest"]["format"] == elastic.MANIFEST_FORMAT
+    assert report["manifest"]["axes"]["data"] == 8
+    assert report["resumable"] is True
+    actions = {e["action"] for e in report["reshard_plan"].values()}
+    # replicated params + data-scattered ZeRO-1 moments under a resized
+    # data axis
+    assert "replicate" in actions and "repartition-zero1" in actions
+
+    chaos.corrupt_file(os.path.join(
+        ckpt_lib._pointed_version_dir(path), "shard_00000.msgpack"
+    ))
+    proc = run()
+    report = json.loads(proc.stdout.splitlines()[-1])
+    assert proc.returncode == 1
+    assert report["ok"] is False and report["resumable"] is False
+
+
+def test_reshard_check_inspect_in_process(tmp_path, devices, monkeypatch):
+    """leaf_plan classification + the unstamped-is-unknowable contract
+    (in-process: no second interpreter/jax import)."""
+    rc = _reshard_check_module()
+    assert rc.parse_target("data=4, tensor=2") == {"data": 4, "tensor": 2}
+    stamped = {"data": 8, "tensor": 2, "pipe": 2}
+    assert rc.leaf_plan("params/w", [], stamped, {"data": 4}) == "replicate"
+    assert rc.leaf_plan(
+        "params/w", ["tensor", None], stamped, {"data": 4, "tensor": 2}
+    ) == "keep"
+    assert rc.leaf_plan(
+        "opt_state/0/mu/w", ["data", None], stamped, {"data": 4}
+    ) == "repartition-zero1"
+    assert rc.leaf_plan(
+        "params/decoder/q_kernel", ["pipe", None], stamped, {"pipe": 1}
+    ) == "rebalance-pipe"
+    assert rc.leaf_plan(
+        "params/w", ["data"], stamped, {"data": 4}
+    ) == "reshard"
+
+    # unstamped checkpoint: resumability is unknowable offline (None),
+    # but intact artifacts still report ok
+    _, _, state0, _, _ = _config("dp8")
+    path = str(tmp_path / "unstamped")
+    monkeypatch.setattr(elastic, "mesh_manifest", lambda state: None)
+    ckpt_lib.save_checkpoint(path, state0, 1, 0.0, {}, sharded=True)
+    monkeypatch.undo()
+    report = rc.inspect_checkpoint(path, {"data": 4})
+    assert report["resumable"] is None
+    assert report["manifest"]["format"] == 2  # sealed but unstamped
+    assert report["ok"] is True

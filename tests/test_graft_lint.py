@@ -193,6 +193,63 @@ def test_nan_launder_suppression_and_scope():
     assert pylint_rules.lint_source("train/step.py", src3) == []
 
 
+def test_ckpt_stamp_fires_on_unstamped_serialize():
+    src = (
+        "from flax import serialization\n"
+        "def _write(path, state):\n"
+        "    blob = serialization.msgpack_serialize({'params': state})\n"
+        "    open(path, 'wb').write(blob)\n"
+    )
+    findings = pylint_rules.lint_source("train/checkpoint.py", src)
+    assert _rules(findings) == ["ckpt-stamp"]
+    assert "mesh-manifest stamp" in findings[0].message
+
+
+def test_ckpt_stamp_quiet_when_manifest_threaded():
+    # referencing the stamp anywhere in the enclosing function sanctions
+    # the write (keyword arg, name, or the payload-key string literal)
+    for ref in (
+        "    payload['mesh_manifest'] = stamp\n",
+        "    use(mesh_manifest)\n",
+    ):
+        src = (
+            "from flax import serialization\n"
+            "def _write(path, payload, stamp, mesh_manifest=None):\n"
+            + ref +
+            "    return serialization.msgpack_serialize(payload)\n"
+        )
+        assert pylint_rules.lint_source("train/checkpoint.py", src) == []
+
+
+def test_ckpt_stamp_suppression_and_scope():
+    src = (
+        "from flax import serialization\n"
+        "def _write(p):\n"
+        "    return serialization.msgpack_serialize(p)"
+        "  # graft-lint: ckpt-stamp\n"
+    )
+    assert pylint_rules.lint_source("train/checkpoint.py", src) == []
+    # outside train/checkpoint.py (tools, tests) the rule stays quiet
+    src2 = (
+        "from flax import serialization\n"
+        "def dump(p):\n"
+        "    return serialization.msgpack_serialize(p)\n"
+    )
+    assert pylint_rules.lint_source("analysis/export.py", src2) == []
+
+
+def test_ckpt_stamp_real_checkpoint_module_lints_clean():
+    # the acceptance gate: every committed checkpoint writer threads the
+    # format-3 stamp (graft-elastic), so the shipped module has no findings
+    path = os.path.join(
+        REPO_ROOT, "distributed_pytorch_example_tpu", "train",
+        "checkpoint.py",
+    )
+    with open(path) as f:
+        src = f.read()
+    assert pylint_rules.lint_source("train/checkpoint.py", src) == []
+
+
 def test_real_instrumented_step_lints_clean():
     # the acceptance gate: the sentinel-instrumented train step passes the
     # full AST rule set (host-sync AND debug-callback) as committed
