@@ -1,6 +1,6 @@
 """Repo-specific Python AST lints (no jax import, no backend).
 
-Seven rules, each a distilled past-regression class:
+Eight rules, each a distilled past-regression class:
 
 - ``host-sync``: ``.item()`` / ``np.asarray`` / ``jax.device_get`` inside
   TRACED-SCOPE sources (``ops/``, ``models/``, ``parallel/``,
@@ -49,6 +49,17 @@ Seven rules, each a distilled past-regression class:
   ``_write_payload`` / ``_save_sharded`` that forgets the stamp silently
   regresses cross-mesh resume; this rule makes that a lint failure.
 
+- ``serve-dynamic-shape``: inside a jit-decorated function in
+  ``serving/``, an ``if``/``while`` whose test reads ``.shape``, or a
+  list ``.append(...)`` (token accumulation). graft-serve's whole
+  contract is TWO compiled programs for the entire workload — bucketed
+  prefill and fixed-slot decode — so continuous batching never
+  recompiles; shape-dependent branching quietly re-specializes the
+  program per request shape (a recompile per novel length), and
+  appending tokens to a Python list inside the traced region either
+  fails tracing or unrolls the loop. Variable length belongs in the
+  HOST scheduler (tables, lens, buckets), never in the traced step.
+
 Scope is static and name-based, not a whole-program call graph — the
 cheap 99% of the check. Deliberate exceptions carry a
 ``# graft-lint: ok`` (all rules) or ``# graft-lint: <rule>`` comment on
@@ -72,6 +83,7 @@ BF16_ACCUM_SCOPE = ("ops/", "train/")
 DEBUG_CALLBACK_SCOPE = ("ops/", "train/step.py")
 NAN_LAUNDER_SCOPE = ("ops/", "train/")
 CKPT_STAMP_SCOPE = ("train/checkpoint.py",)
+SERVE_SCOPE = ("serving/",)
 
 _ACCUM_CTORS = ("zeros", "zeros_like", "full", "empty")
 
@@ -271,6 +283,78 @@ def _ckpt_stamp_findings(
     return [flagged[k] for k in sorted(flagged)]
 
 
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    """Whether a decorator expression jits the function: ``jit``,
+    ``jax.jit``, or a ``partial(jax.jit, ...)`` of any spelling."""
+    if isinstance(dec, ast.Call):
+        fn = dec.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if name == "partial":
+            return any(_is_jit_decorator(a) for a in dec.args)
+        return name == "jit"
+    name = dec.attr if isinstance(dec, ast.Attribute) else (
+        dec.id if isinstance(dec, ast.Name) else None
+    )
+    return name == "jit"
+
+
+def _serve_dynamic_shape_findings(
+    tree: ast.Module, relpath: str, supp: Dict[int, Set[str]]
+) -> List[Finding]:
+    """Shape-dependent branches / list-append accumulation inside jitted
+    serving programs (module docstring: the two-programs contract)."""
+    flagged: Dict[int, Finding] = {}  # keyed by line: nesting dedup
+    for func in ast.walk(tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not any(_is_jit_decorator(d) for d in func.decorator_list):
+            continue
+        for node in ast.walk(func):
+            if isinstance(node, (ast.If, ast.While)):
+                shape_read = any(
+                    isinstance(sub, ast.Attribute) and sub.attr == "shape"
+                    for sub in ast.walk(node.test)
+                )
+                if shape_read and not _suppressed(
+                    supp, node.lineno, "serve-dynamic-shape"
+                ):
+                    flagged.setdefault(node.lineno, Finding(
+                        rule="serve-dynamic-shape",
+                        where=f"{relpath}:{node.lineno}",
+                        message=(
+                            ".shape-dependent branch inside a jitted "
+                            "serving program: each novel request shape "
+                            "re-specializes (recompiles) the step, "
+                            "breaking the two-compiled-programs contract "
+                            "— route variable length through the host "
+                            "scheduler (page tables / row lens / "
+                            "prefill buckets)"
+                        ),
+                    ))
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "append"
+            ):
+                if not _suppressed(
+                    supp, node.lineno, "serve-dynamic-shape"
+                ):
+                    flagged.setdefault(node.lineno, Finding(
+                        rule="serve-dynamic-shape",
+                        where=f"{relpath}:{node.lineno}",
+                        message=(
+                            "list .append(...) token accumulation inside "
+                            "a jitted serving program: growing a Python "
+                            "list under trace either fails or unrolls the "
+                            "loop into the program; write tokens into "
+                            "fixed-shape slot arrays on the host instead"
+                        ),
+                    ))
+    return [flagged[k] for k in sorted(flagged)]
+
+
 def lint_source(relpath: str, source: str) -> List[Finding]:
     """All AST findings for one package source file.
 
@@ -448,6 +532,8 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
         findings.extend(_bf16_accum_findings(tree, relpath, supp))
     if _in_scope(relpath, CKPT_STAMP_SCOPE):
         findings.extend(_ckpt_stamp_findings(tree, relpath, supp))
+    if _in_scope(relpath, SERVE_SCOPE):
+        findings.extend(_serve_dynamic_shape_findings(tree, relpath, supp))
     return findings
 
 

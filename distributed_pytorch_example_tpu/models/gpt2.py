@@ -51,6 +51,12 @@ class GPT2(nn.Module):
     # forward time (~3 units, extra temp memory — parallel/pipeline.py)
     pipe_recompute: bool = True
     decode: bool = False  # autoregressive KV-cache mode (train/generate.py)
+    # paged KV cache (graft-serve, serving/engine.py): > 0 swaps the
+    # contiguous decode cache for a shared block pool + per-row page
+    # tables; requires decode=True. See transformer.MultiHeadAttention.
+    paged_num_blocks: int = 0
+    paged_block_size: int = 16
+    paged_max_blocks: int = 0
     # "full": return (B, S, V) logits. "hidden": return the final hidden
     # states instead, for the fused chunked-CE loss (train/tasks.py pairs
     # it with ``head_params``) — the f32 logits tensor never materializes.
@@ -75,6 +81,10 @@ class GPT2(nn.Module):
         validate_pipe_schedule(self, targets)
         if self.decode and self.logits_mode != "full":
             raise ValueError("decode mode requires logits_mode='full'")
+        if self.paged_num_blocks > 0 and not self.decode:
+            raise ValueError(
+                "paged_num_blocks > 0 (paged KV cache) requires decode=True"
+            )
         if (
             self.pipe_axis is not None
             and self.seq_axis
@@ -115,7 +125,27 @@ class GPT2(nn.Module):
             nn.initializers.normal(stddev=0.01),
             (1, self.max_len, self.model_dim),
         )
-        if self.decode:
+        if self.decode and self.paged_num_blocks > 0:
+            # paged decode: rows sit at independent offsets, so the learned
+            # position table is gathered per row from the engine-owned
+            # row_lens (the top-level twin of the attention layers'
+            # row_lens cache variable — the engine rewrites them together)
+            lens = self.variable(
+                "cache", "row_lens", jnp.zeros, (tokens.shape[0],),
+                jnp.int32,
+            )
+            if self.is_initializing():
+                pos_slice = pos[:, : tokens.shape[1]]
+            else:
+                positions = (
+                    lens.value[:, None]
+                    + jnp.arange(tokens.shape[1])[None, :]
+                )
+                pos_slice = jnp.take(
+                    pos[0], jnp.minimum(positions, self.max_len - 1),
+                    axis=0,
+                )
+        elif self.decode:
             # position cursor mirrors the attention caches' cache_index
             cursor = self.variable(
                 "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
@@ -184,6 +214,9 @@ class GPT2(nn.Module):
                 seq_axis=self.seq_axis,
                 sp_mode=self.sp_mode,
                 decode=self.decode,
+                paged_num_blocks=self.paged_num_blocks,
+                paged_block_size=self.paged_block_size,
+                paged_max_blocks=self.paged_max_blocks,
                 remat=self.remat,
                 moe_experts=self.moe_experts,
                 moe_every=self.moe_every,

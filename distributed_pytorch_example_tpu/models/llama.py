@@ -81,6 +81,9 @@ class LlamaBlock(nn.Module):
     seq_axis: Optional[str] = None
     sp_mode: str = "ulysses"  # default; ring also serves GQA (chunk-local expand)
     decode: bool = False
+    paged_num_blocks: int = 0  # >0: paged KV cache (serving/engine.py)
+    paged_block_size: int = 16
+    paged_max_blocks: int = 0
     moe_experts: int = 0  # >0: Mixtral-style SwiGLU-expert MoE MLP
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -100,6 +103,9 @@ class LlamaBlock(nn.Module):
             rope_theta=self.rope_theta,
             sp_mode=self.sp_mode,
             decode=self.decode,
+            paged_num_blocks=self.paged_num_blocks,
+            paged_block_size=self.paged_block_size,
+            paged_max_blocks=self.paged_max_blocks,
             name="attn",
         )
         if self.moe_experts:
@@ -142,6 +148,9 @@ class Llama(nn.Module):
     seq_axis: Optional[str] = None
     sp_mode: str = "ulysses"
     decode: bool = False
+    paged_num_blocks: int = 0  # >0: paged KV cache (serving/engine.py)
+    paged_block_size: int = 16
+    paged_max_blocks: int = 0
     remat: bool = False
     pipe_axis: Optional[str] = None  # mesh axis for pipeline stages (PP)
     pipe_microbatches: int = 0  # 0 = auto
@@ -179,6 +188,10 @@ class Llama(nn.Module):
         validate_pipe_schedule(self, targets)
         if self.decode and self.logits_mode != "full":
             raise ValueError("decode mode requires logits_mode='full'")
+        if self.paged_num_blocks > 0 and not self.decode:
+            raise ValueError(
+                "paged_num_blocks > 0 (paged KV cache) requires decode=True"
+            )
         if (
             self.pipe_axis is not None
             and self.seq_axis
@@ -269,6 +282,9 @@ class Llama(nn.Module):
                 seq_axis=self.seq_axis,
                 sp_mode=self.sp_mode,
                 decode=self.decode,
+                paged_num_blocks=self.paged_num_blocks,
+                paged_block_size=self.paged_block_size,
+                paged_max_blocks=self.paged_max_blocks,
                 moe_experts=self.moe_experts if is_moe else 0,
                 moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,

@@ -89,6 +89,16 @@ class MultiHeadAttention(nn.Module):
     rope_theta: float = 10000.0
     sp_mode: str = "ring"  # sequence parallelism: "ring" | "ulysses"
     decode: bool = False  # autoregressive KV-cache mode (train/generate.py)
+    # paged KV cache (graft-serve, serving/engine.py). > 0 switches decode
+    # mode from the contiguous per-call cache to a fixed block pool +
+    # per-row page tables: ``paged_num_blocks`` blocks of
+    # ``paged_block_size`` tokens shared by every resident request, with
+    # at most ``paged_max_blocks`` table entries per batch row. Block 0 is
+    # a scratch block: unallocated table entries point at it, so writes
+    # past a row's true length land harmlessly.
+    paged_num_blocks: int = 0
+    paged_block_size: int = 16
+    paged_max_blocks: int = 0
 
     @nn.compact
     def __call__(self, x, mask=None, *, kv_mask=None, train: bool = False):
@@ -144,7 +154,10 @@ class MultiHeadAttention(nn.Module):
                     "decode mode supports causal attention only, without "
                     "masks or sequence parallelism"
                 )
-            out = self._decode_step(q, k, v, batch, seq, kv_heads)
+            if self.paged_num_blocks > 0:
+                out = self._paged_step(q, k, v, batch, seq, kv_heads)
+            else:
+                out = self._decode_step(q, k, v, batch, seq, kv_heads)
             out = out.reshape((batch, seq, features))
             out = nn.Dense(self.model_dim, dtype=self.dtype, name="o")(out)
             return out
@@ -278,6 +291,139 @@ class MultiHeadAttention(nn.Module):
             use_flash=False,  # 1..n-token queries: XLA path is right-sized
         )
 
+    def _paged_step(self, q, k, v, batch, seq, kv_heads):
+        """Paged-KV attention (graft-serve): a fixed block pool shared by
+        all resident requests, addressed through per-row page tables.
+
+        Cache variables per attention layer:
+
+        - ``pages_k`` / ``pages_v`` (num_blocks, block_size, kv_heads,
+          head_dim) — the pool. Sharded like the contiguous cache: the
+          kv-heads dim over ``tensor``; the block dim takes the batch
+          row's place over the data axes (serving/engine.py constrains
+          both, and its allocator keeps a slot's blocks on the slot's
+          data shard).
+        - ``page_table`` (batch, max_blocks) int32 — block j of row b
+          lives in pool block ``page_table[b, j]``. Entry 0 (the scratch
+          block) absorbs writes past a row's allocation.
+        - ``row_lens`` (batch,) int32 — tokens already cached per row.
+
+        Unlike the contiguous path's ``cache_index`` cursor, the table
+        and lengths are OWNED BY THE HOST scheduler: the engine rewrites
+        them between steps (insertion/eviction), so this method never
+        updates them. Static shape split: ``seq > 1`` is the bucketed
+        prefill program, ``seq == 1`` the one-token-per-slot decode
+        program — together the two compiled programs of the engine.
+        """
+        from jax import lax
+
+        nb, bs = self.paged_num_blocks, self.paged_block_size
+        mb = self.paged_max_blocks
+        if nb < 2 or bs < 1 or mb < 1:
+            raise ValueError(
+                "paged decode needs paged_num_blocks >= 2 (block 0 is "
+                "scratch), paged_block_size >= 1 and paged_max_blocks >= "
+                f"1; got {nb}/{bs}/{mb}"
+            )
+        is_init = self.has_variable("cache", "pages_k")
+        pages_k = self.variable(
+            "cache", "pages_k", jnp.zeros,
+            (nb, bs, kv_heads, self.head_dim), self.dtype,
+        )
+        pages_v = self.variable(
+            "cache", "pages_v", jnp.zeros,
+            (nb, bs, kv_heads, self.head_dim), self.dtype,
+        )
+        table = self.variable(
+            "cache", "page_table", jnp.zeros, (batch, mb), jnp.int32
+        )
+        lens = self.variable(
+            "cache", "row_lens", jnp.zeros, (batch,), jnp.int32
+        )
+        if not is_init:  # init pass: just size the pool, output is unused
+            return jnp.zeros(
+                (batch, seq, self.num_heads, self.head_dim), self.dtype
+            )
+
+        positions = lens.value[:, None] + jnp.arange(seq)[None, :]  # (B, S)
+        if self.rope:
+            from distributed_pytorch_example_tpu.ops.rope import rope
+
+            q = rope(q, positions=positions, theta=self.rope_theta)
+            k = rope(k, positions=positions, theta=self.rope_theta)
+
+        if seq > 1:
+            # ---- prefill: fresh rows (row_lens == 0 by engine contract),
+            # bucket-padded to a multiple of the block size. Attention is
+            # plain causal self-attention over this call's tokens (pad
+            # tokens sit at later positions, so real logits never see
+            # them); K/V land in the rows' pool blocks via one
+            # dynamic_update_slice per (row, block) — unrolled, the
+            # bucket size is static.
+            if seq % bs:
+                raise ValueError(
+                    f"prefill length {seq} must be a multiple of "
+                    f"paged_block_size {bs}"
+                )
+            n_blk = seq // bs
+            if n_blk > mb:
+                raise ValueError(
+                    f"prefill bucket {seq} needs {n_blk} blocks > "
+                    f"paged_max_blocks {mb}"
+                )
+            kb = k.astype(pages_k.value.dtype).reshape(
+                batch, n_blk, bs, kv_heads, self.head_dim
+            )
+            vb = v.astype(pages_v.value.dtype).reshape(
+                batch, n_blk, bs, kv_heads, self.head_dim
+            )
+            pk, pv = pages_k.value, pages_v.value
+            for b in range(batch):
+                for j in range(n_blk):
+                    pid = table.value[b, j]
+                    pk = lax.dynamic_update_slice(
+                        pk, kb[b, j][None], (pid, 0, 0, 0)
+                    )
+                    pv = lax.dynamic_update_slice(
+                        pv, vb[b, j][None], (pid, 0, 0, 0)
+                    )
+            pages_k.value, pages_v.value = pk, pv
+            return dot_product_attention(
+                q, k, v, causal=True, use_flash=False,
+            )
+
+        # ---- decode: one new token per row at position row_lens[b].
+        # One vectorized scatter into (block, offset) per row; inactive
+        # rows' tables are all-scratch, so their writes pile up on block
+        # (0, 0) and are never read by a live row.
+        pos = lens.value  # (B,)
+        block_idx = jnp.take_along_axis(
+            table.value, (pos // bs)[:, None], axis=1
+        )[:, 0]
+        off = pos % bs
+        pages_k.value = pages_k.value.at[block_idx, off].set(
+            k[:, 0].astype(pages_k.value.dtype)
+        )
+        pages_v.value = pages_v.value.at[block_idx, off].set(
+            v[:, 0].astype(pages_v.value.dtype)
+        )
+        # gather each row's blocks back into position order: gathered key
+        # j*bs + o is exactly the token at position j*bs + o, so the
+        # visibility mask is the same `key_pos <= position` predicate the
+        # contiguous path uses — numerics match token-for-token.
+        gk = jnp.take(pages_k.value, table.value, axis=0).reshape(
+            batch, mb * bs, kv_heads, self.head_dim
+        )
+        gv = jnp.take(pages_v.value, table.value, axis=0).reshape(
+            batch, mb * bs, kv_heads, self.head_dim
+        )
+        key_pos = jnp.arange(mb * bs)[None, None, None, :]
+        visible = key_pos <= pos[:, None, None, None]
+        return dot_product_attention(
+            q, gk, gv, mask=visible, causal=False,
+            use_flash=False,  # single-token queries: XLA path is right-sized
+        )
+
     def _ring_mesh(self, mask):
         """The active mesh when sequence parallelism should run, else None.
 
@@ -349,6 +495,9 @@ class TransformerBlock(nn.Module):
     seq_axis: Optional[str] = None
     sp_mode: str = "ring"
     decode: bool = False
+    paged_num_blocks: int = 0  # >0: paged KV cache (serving/engine.py)
+    paged_block_size: int = 16
+    paged_max_blocks: int = 0
     moe_experts: int = 0  # >0: Mixture-of-Experts MLP with this many experts
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
@@ -366,6 +515,9 @@ class TransformerBlock(nn.Module):
             seq_axis=self.seq_axis,
             sp_mode=self.sp_mode,
             decode=self.decode,
+            paged_num_blocks=self.paged_num_blocks,
+            paged_block_size=self.paged_block_size,
+            paged_max_blocks=self.paged_max_blocks,
             name="attn",
         )
         if self.moe_experts:
@@ -421,6 +573,9 @@ class TransformerStack(nn.Module):
     seq_axis: Optional[str] = None
     sp_mode: str = "ring"
     decode: bool = False
+    paged_num_blocks: int = 0  # >0: paged KV cache (serving/engine.py)
+    paged_block_size: int = 16
+    paged_max_blocks: int = 0
     remat: bool = False
     moe_experts: int = 0
     moe_every: int = 2  # MoE MLP on every Nth block (Switch uses 2)
@@ -450,6 +605,9 @@ class TransformerStack(nn.Module):
                 seq_axis=self.seq_axis,
                 sp_mode=self.sp_mode,
                 decode=self.decode,
+                paged_num_blocks=self.paged_num_blocks,
+                paged_block_size=self.paged_block_size,
+                paged_max_blocks=self.paged_max_blocks,
                 moe_experts=self.moe_experts if is_moe else 0,
                 moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,

@@ -27,8 +27,10 @@ def rope(
 ) -> jax.Array:
     """Rotate (B, S, N, H) queries or keys by their positions.
 
-    ``positions``: (S,) int32 global positions; default arange(S). Angles
-    are computed in float32 regardless of the compute dtype.
+    ``positions``: (S,) int32 global positions shared across the batch, or
+    (B, S) per-row positions (paged decode: each slot sits at its own
+    offset); default arange(S). Angles are computed in float32 regardless
+    of the compute dtype.
     """
     head_dim = x.shape[-1]
     if head_dim % 2:
@@ -37,6 +39,16 @@ def rope(
     if positions is None:
         positions = jnp.arange(x.shape[1])
     freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    if positions.ndim == 2:  # (B, S): per-row offsets
+        angles = positions.astype(jnp.float32)[..., None] * freqs
+        cos = jnp.cos(angles)[:, :, None, :]
+        sin = jnp.sin(angles)[:, :, None, :]
+        x1 = x[..., :half].astype(jnp.float32)
+        x2 = x[..., half:].astype(jnp.float32)
+        rotated = jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        )
+        return rotated.astype(x.dtype)
     angles = positions.astype(jnp.float32)[:, None] * freqs  # (S, half)
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]

@@ -27,7 +27,12 @@ Fault kinds:
   kill-a-slice site graft-elastic's shrink-to-survivors scenario uses);
 - ``rendezvous-flake`` — fail (after an optional delay) the next
   ``count`` entries into the named transient site (e.g. coordinator
-  rendezvous in ``runtime/distributed.initialize``).
+  rendezvous in ``runtime/distributed.initialize``);
+- ``poison-request`` — NaN-poison the logits of serving request ``at``
+  (the request id) for ``count`` sampled tokens starting at generated-
+  token index ``step`` (exercises graft-serve's bad-request isolation:
+  the request is evicted with an error status, co-resident requests are
+  untouched — serving/engine.py, scripts/chaos_sweep.py).
 """
 
 from __future__ import annotations
@@ -45,7 +50,10 @@ from distributed_pytorch_example_tpu.runtime.logging import get_logger
 logger = get_logger(__name__)
 
 ENV_VAR = "DPX_CHAOS"
-KINDS = ("nan-batch", "inf-batch", "io-error", "kill", "rendezvous-flake")
+KINDS = (
+    "nan-batch", "inf-batch", "io-error", "kill", "rendezvous-flake",
+    "poison-request",
+)
 
 
 @dataclasses.dataclass
@@ -268,6 +276,30 @@ def transient_failure(name: str) -> None:
             raise RuntimeError(
                 f"chaos: injected transient failure at {name!r}"
             )
+
+
+def poison_request(request_id: str, token_index: int) -> bool:
+    """Whether a serving request's logits should be NaN-poisoned for the
+    generated token at ``token_index`` (0-based). The engine feeds the
+    returned flag into its compiled step as a regular input, so the
+    poisoned step runs the SAME executable as a clean one — the
+    no-recompile injection contract the other hooks follow."""
+    plan = active()
+    if plan is None:
+        return False
+    for fault in plan.faults:
+        if (
+            fault.kind == "poison-request"
+            and fault.at == str(request_id)
+            and fault.step <= token_index < fault.step + fault.count
+        ):
+            fault.fired += 1
+            logger.warning(
+                "chaos: poisoning request %r at generated token %d",
+                request_id, token_index,
+            )
+            return True
+    return False
 
 
 # ---------------------------------------------------------------------------

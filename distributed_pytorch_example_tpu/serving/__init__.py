@@ -1,0 +1,39 @@
+"""graft-serve: paged-KV continuous-batching inference.
+
+Reference behavioural surface: online serving of checkpoints produced by
+the training loop, mirroring the reference repo's inference entrypoint
+while staying TPU-native — two fixed compiled programs (bucketed prefill,
+fixed-slot decode), a host-side block allocator/scheduler, and pool
+shardings that match the training partitioner so TP checkpoints serve
+without gathering.
+"""
+
+from distributed_pytorch_example_tpu.serving.cache import (
+    SCRATCH_BLOCK,
+    BlockAllocator,
+    PagedCacheConfig,
+)
+from distributed_pytorch_example_tpu.serving.engine import InferenceEngine
+from distributed_pytorch_example_tpu.serving.sampling import (
+    fold_keys,
+    sample_rows,
+    truncate_logits,
+)
+from distributed_pytorch_example_tpu.serving.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+)
+
+__all__ = [
+    "SCRATCH_BLOCK",
+    "BlockAllocator",
+    "InferenceEngine",
+    "PagedCacheConfig",
+    "Request",
+    "RequestState",
+    "Scheduler",
+    "fold_keys",
+    "sample_rows",
+    "truncate_logits",
+]

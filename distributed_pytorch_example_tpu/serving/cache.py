@@ -1,0 +1,118 @@
+"""Paged-KV block pool bookkeeping (host side).
+
+The device state — per-layer ``pages_k``/``pages_v`` pools, ``page_table``
+and ``row_lens`` cache variables — lives in the model's flax ``cache``
+collection (models/transformer.py ``_paged_step``). This module owns the
+HOST truth the scheduler mutates between compiled steps: which pool
+blocks are free, which request holds which blocks, and how table rows are
+laid out.
+
+Block 0 is the scratch block: it is never allocated, every unallocated
+``page_table`` entry points at it, and writes past a row's true length
+land there (they are masked out of every live row's attention).
+
+Sharding affinity: when the engine runs under a mesh whose data axes span
+``num_shards`` > 1, the pool's block dim is sharded over those axes in
+``num_shards`` contiguous ranges. The allocator keeps one free list per
+range and serves slot ``s`` from range ``s * num_shards // num_slots`` —
+a slot's blocks live on the slot's data shard, mirroring the contiguous
+cache's batch-rows-over-``data`` placement at block granularity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+SCRATCH_BLOCK = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedCacheConfig:
+    """Static shape of the paged KV cache; one per engine."""
+
+    num_blocks: int          # pool blocks per layer, including scratch
+    block_size: int          # tokens per block
+    max_blocks_per_slot: int  # page-table width (max context / block_size)
+    num_slots: int           # decode batch rows
+    num_shards: int = 1      # data-axis span the pool block dim shards over
+
+    def __post_init__(self):
+        if self.num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is scratch), got "
+                f"{self.num_blocks}"
+            )
+        if self.block_size < 1 or self.max_blocks_per_slot < 1:
+            raise ValueError("block_size and max_blocks_per_slot must be >= 1")
+        if self.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {self.num_slots}")
+        if self.num_shards > 1 and self.num_blocks % self.num_shards:
+            raise ValueError(
+                f"num_blocks {self.num_blocks} not divisible by the data-"
+                f"axis span {self.num_shards} (pool block dim shards over "
+                "the data axes)"
+            )
+
+    @property
+    def max_context(self) -> int:
+        return self.max_blocks_per_slot * self.block_size
+
+    def blocks_for(self, tokens: int) -> int:
+        """Blocks needed to hold positions [0, tokens)."""
+        return -(-tokens // self.block_size)
+
+
+class BlockAllocator:
+    """Per-shard free lists over the pool's allocatable blocks.
+
+    Deterministic: blocks are handed out and recycled LIFO per shard, so
+    a replayed workload allocates identically — the property the chaos
+    ``poison-request`` bit-identical assertion leans on.
+    """
+
+    def __init__(self, config: PagedCacheConfig):
+        self.config = config
+        per = config.num_blocks // config.num_shards
+        self._free: List[List[int]] = []
+        for s in range(config.num_shards):
+            lo, hi = s * per, (s + 1) * per
+            blocks = [b for b in range(lo, hi) if b != SCRATCH_BLOCK]
+            blocks.reverse()  # pop() hands out the range's low blocks first
+            self._free.append(blocks)
+        self._owner_shard: Dict[int, int] = {
+            b: s for s in range(config.num_shards)
+            for b in range(s * per, (s + 1) * per)
+        }
+
+    def shard_of_slot(self, slot: int) -> int:
+        return slot * self.config.num_shards // self.config.num_slots
+
+    def free_count(self, shard: Optional[int] = None) -> int:
+        if shard is None:
+            return sum(len(f) for f in self._free)
+        return len(self._free[shard])
+
+    def alloc(self, n: int, shard: int = 0) -> Optional[List[int]]:
+        """Pop ``n`` blocks from ``shard``'s free list, or None (caller
+        decides between queueing and preemption) without partial grants."""
+        free = self._free[shard]
+        if n > len(free):
+            return None
+        return [free.pop() for _ in range(n)]
+
+    def release(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if b == SCRATCH_BLOCK:
+                raise ValueError("scratch block is never allocated/released")
+            self._free[self._owner_shard[b]].append(b)
+
+    def table_row(self, blocks: Sequence[int]) -> List[int]:
+        """A full-width page-table row: the request's blocks, scratch-
+        padded to ``max_blocks_per_slot``."""
+        mb = self.config.max_blocks_per_slot
+        if len(blocks) > mb:
+            raise ValueError(
+                f"{len(blocks)} blocks exceed the table width {mb}"
+            )
+        return list(blocks) + [SCRATCH_BLOCK] * (mb - len(blocks))

@@ -1,0 +1,528 @@
+"""graft-serve: continuous-batching inference over a paged KV cache.
+
+Exactly two compiled programs serve the whole workload (plus one prefill
+variant per length bucket), so in-flight batching never recompiles:
+
+- ``_prefill_step`` — one request, bucket-padded prompt. Runs causal
+  self-attention over the prompt, writes its K/V into the request's pool
+  blocks, and samples the first token from the last REAL position.
+- ``_decode_step`` — one token for every slot of a fixed slot array.
+  Inactive slots ride along pointed at the scratch block; their sampled
+  tokens are discarded on the host.
+
+The paged pool lives in the model's flax ``cache`` collection
+(models/transformer.py ``_paged_step``); the engine owns the canonical
+cache pytree between calls and rewrites the ``page_table`` / ``row_lens``
+leaves at every decode boundary from the scheduler's host state. Pool
+shardings mirror the contiguous decode cache (train/generate.py
+``_constrain_cache``): kv-heads over ``tensor``, the block dim over the
+data axes — a TP-trained checkpoint serves without gathering.
+
+Robustness (graft-armor): device fetches run under ``with_retries``; a
+request whose last-position logits go nonfinite (or is poisoned by the
+``poison-request`` chaos fault) is evicted with an error status at the
+next boundary while its co-residents' streams continue bit-identically —
+per-row attention, per-request position-folded rng, and per-row sampling
+share no cross-row state. Telemetry (graft-scope): per-request
+queue/prefill/decode trace spans land in the Chrome trace.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_pytorch_example_tpu.robustness import chaos
+from distributed_pytorch_example_tpu.robustness.retry import with_retries
+from distributed_pytorch_example_tpu.serving.cache import (
+    SCRATCH_BLOCK,
+    BlockAllocator,
+    PagedCacheConfig,
+)
+from distributed_pytorch_example_tpu.serving.sampling import (
+    fold_keys,
+    sample_rows,
+)
+from distributed_pytorch_example_tpu.serving.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+)
+
+__all__ = ["InferenceEngine", "Request"]
+
+
+def _constrain_paged_cache(cache, mesh, batch_axes: Tuple):
+    """Pin pool shardings: kv-heads over 'tensor', the block dim over the
+    data axes (both only when they divide — mirroring generate()'s
+    ``_constrain_cache``); tables and lengths replicated."""
+
+    def spec_for(path, leaf):
+        name = getattr(path[-1], "key", "")
+        if name in ("pages_k", "pages_v") and leaf.ndim == 4:
+            dp = 1
+            for a in batch_axes:
+                dp *= mesh.shape.get(a, 1)
+            blocks = (
+                tuple(batch_axes)
+                if dp > 1 and leaf.shape[0] % dp == 0 else None
+            )
+            tp = mesh.shape.get("tensor", 1)
+            heads = "tensor" if tp > 1 and leaf.shape[2] % tp == 0 else None
+            return lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, P(blocks, None, heads, None))
+            )
+        return lax.with_sharding_constraint(leaf, NamedSharding(mesh, P()))
+
+    return jax.tree_util.tree_map_with_path(spec_for, cache)
+
+
+def _with_tables(cache, table, lens):
+    """Overwrite every engine-owned leaf — ``page_table`` on attention
+    layers, ``row_lens`` on attention layers AND the model top level
+    (GPT-2's position gather) — with the scheduler's current host state."""
+
+    def fix(path, leaf):
+        name = getattr(path[-1], "key", "")
+        if name == "page_table":
+            return table
+        if name == "row_lens":
+            return lens
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def _merge_pages(canonical, updated):
+    """Fold a prefill call's pool writes back into the canonical (decode-
+    shaped) cache; table/length leaves keep their canonical shapes."""
+
+    def pick(path, old, new):
+        name = getattr(path[-1], "key", "")
+        return new if name in ("pages_k", "pages_v") else old
+
+    return jax.tree_util.tree_map_with_path(pick, canonical, updated)
+
+
+@partial(
+    jax.jit,
+    static_argnums=(0,),
+    static_argnames=("temperature", "top_k", "top_p", "mesh", "batch_axes"),
+)
+def _prefill_step(model, params, cache, tokens, key, length, poison, *,
+                  temperature, top_k, top_p, mesh=None, batch_axes=()):
+    """One bucket-padded prompt -> (updated cache, first token, finite?)."""
+    if mesh is not None:
+        cache = _constrain_paged_cache(cache, mesh, tuple(batch_axes))
+    logits, vars_ = model.apply(
+        {"params": params, "cache": cache}, tokens, train=False,
+        mutable=["cache"],
+    )
+    row = lax.dynamic_slice_in_dim(
+        logits[0].astype(jnp.float32), length - 1, 1, axis=0
+    )  # (1, V): the last REAL position's logits, not the bucket end's
+    row = jnp.where(poison, jnp.float32(jnp.nan), row)
+    ok = jnp.all(jnp.isfinite(row))
+    step_key = jax.random.fold_in(key, length)
+    tok = sample_rows(row, step_key[None], temperature, top_k, top_p)[0]
+    return vars_["cache"], tok, ok
+
+
+@partial(
+    jax.jit,
+    static_argnums=(0,),
+    static_argnames=("temperature", "top_k", "top_p", "mesh", "batch_axes"),
+)
+def _decode_step(model, params, cache, tokens, keys, positions, poison, *,
+                 temperature, top_k, top_p, mesh=None, batch_axes=()):
+    """One token per slot -> (updated cache, next tokens, finite mask).
+
+    ``positions[b]`` is the absolute position of the token being SAMPLED
+    for row b (= row_lens + 1); it doubles as the rng fold, keeping the
+    draw identical to ``generate(rng_fold="position")``.
+    """
+    if mesh is not None:
+        cache = _constrain_paged_cache(cache, mesh, tuple(batch_axes))
+    logits, vars_ = model.apply(
+        {"params": params, "cache": cache}, tokens[:, None], train=False,
+        mutable=["cache"],
+    )
+    logits = logits[:, -1].astype(jnp.float32)  # (B, V)
+    logits = jnp.where(poison[:, None], jnp.float32(jnp.nan), logits)
+    ok = jnp.all(jnp.isfinite(logits), axis=-1)
+    nxt = sample_rows(
+        logits, fold_keys(keys, positions), temperature, top_k, top_p
+    )
+    return vars_["cache"], nxt, ok
+
+
+def _percentiles(samples: Sequence[float]) -> Dict[str, float]:
+    if not samples:
+        return {"p50": None, "p95": None, "p99": None}
+    arr = np.asarray(samples, dtype=np.float64)
+    return {
+        f"p{q}": float(np.percentile(arr, q)) for q in (50, 95, 99)
+    }
+
+
+class InferenceEngine:
+    """Continuous-batching serving loop over one paged-decode model.
+
+    ``model`` must be constructed with ``decode=True`` and the paged
+    fields set (``paged_num_blocks`` / ``paged_block_size`` /
+    ``paged_max_blocks``); ``params`` are the training checkpoint's,
+    unchanged. ``partitioner`` (optional) serves a TP/DP-trained
+    checkpoint sharded, exactly like ``generate(partitioner=...)``.
+
+    ``clock`` / ``sleep`` are injectable for virtual-clock tests; the
+    open-loop ``run()`` honors each request's ``arrival`` timestamp
+    against that clock.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        num_slots: int = 4,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        prefill_buckets: Optional[Sequence[int]] = None,
+        partitioner=None,
+        trace=None,
+        clock=time.monotonic,
+        sleep=time.sleep,
+        mode: str = "continuous",
+    ):
+        nb = int(getattr(model, "paged_num_blocks", 0))
+        bs = int(getattr(model, "paged_block_size", 0))
+        mb = int(getattr(model, "paged_max_blocks", 0))
+        if nb < 2 or not getattr(model, "decode", False):
+            raise ValueError(
+                "InferenceEngine needs a paged decode model: construct it "
+                "with decode=True and paged_num_blocks/paged_block_size/"
+                "paged_max_blocks set (same params as the training model)"
+            )
+        self.model = model
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.top_p = top_p
+        self.trace = trace
+        self.clock = clock
+        self.sleep = sleep
+        self.mode = mode
+
+        self._mesh = None
+        self._batch_axes: Tuple = ()
+        dp = 1
+        if partitioner is not None:
+            self._mesh = partitioner.mesh
+            batch_axes = partitioner.batch_spec()[0]
+            if isinstance(batch_axes, str):
+                batch_axes = (batch_axes,)
+            self._batch_axes = tuple(batch_axes or ())
+            for a in self._batch_axes:
+                dp *= self._mesh.shape.get(a, 1)
+            params = partitioner.shard_tree(params)
+        self.params = params
+        # the allocator's shard map must MATCH the pool constraint: the
+        # block dim shards over the data axes only when it divides
+        self.config = PagedCacheConfig(
+            num_blocks=nb, block_size=bs, max_blocks_per_slot=mb,
+            num_slots=num_slots,
+            num_shards=dp if dp > 1 and nb % dp == 0 else 1,
+        )
+        max_len = int(getattr(model, "max_len", self.config.max_context))
+        if prefill_buckets is None:
+            cap = min(self.config.max_context, max_len)
+            prefill_buckets, b = [], bs
+            while b <= cap:
+                prefill_buckets.append(b)
+                b *= 2
+            if prefill_buckets and prefill_buckets[-1] != cap and (
+                cap % bs == 0
+            ):
+                prefill_buckets.append(cap)
+        self.prefill_buckets = sorted(set(int(b) for b in prefill_buckets))
+        for b in self.prefill_buckets:
+            if b % bs or b > max_len:
+                raise ValueError(
+                    f"prefill bucket {b} must be a multiple of "
+                    f"block_size {bs} and <= max_len {max_len}"
+                )
+
+        with self._mesh_ctx():
+            self._cache = model.init(
+                jax.random.key(0),
+                jnp.zeros((num_slots, 1), jnp.int32),
+                train=False,
+            )["cache"]
+        # per-slot device-side sampling state (host-written at boundaries)
+        self._slot_keys = jax.vmap(jax.random.key)(
+            jnp.zeros((num_slots,), jnp.uint32)
+        )
+        self._slot_tokens = np.zeros((num_slots,), np.int32)
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _mesh_ctx(self):
+        import contextlib
+
+        return self._mesh if self._mesh is not None else (
+            contextlib.nullcontext()
+        )
+
+    def _static_kw(self) -> dict:
+        kw = dict(
+            temperature=self.temperature, top_k=self.top_k, top_p=self.top_p,
+        )
+        if self._mesh is not None:
+            kw.update(mesh=self._mesh, batch_axes=self._batch_axes)
+        return kw
+
+    def _bucket_for(self, prompt_len: int) -> int:
+        for b in self.prefill_buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest prefill "
+            f"bucket {self.prefill_buckets[-1]}"
+        )
+
+    def _ts_us(self) -> int:
+        return int(self.clock() * 1e6)
+
+    def _span(self, name: str, t0_us: int) -> None:
+        if self.trace is not None:
+            self.trace.add_complete(name, t0_us, self._ts_us() - t0_us)
+
+    # -- the two programs -------------------------------------------------
+
+    def _run_prefill(self, st: RequestState, alloc: BlockAllocator) -> bool:
+        req = st.request
+        plen = st.prompt_len
+        bucket = self._bucket_for(plen)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :plen] = np.asarray(req.prompt, np.int32)
+        table = jnp.asarray(
+            [alloc.table_row(st.blocks)], jnp.int32
+        )  # (1, max_blocks)
+        lens = jnp.zeros((1,), jnp.int32)
+        poison = chaos.poison_request(req.rid, 0)
+        t0 = self._ts_us()
+        with self._mesh_ctx():
+            out_cache, tok, ok = _prefill_step(
+                self.model, self.params,
+                _with_tables(self._cache, table, lens),
+                jnp.asarray(tokens), jax.random.key(req.seed),
+                jnp.int32(plen), jnp.asarray(poison),
+                **self._static_kw(),
+            )
+            tok, ok = with_retries(
+                lambda: jax.device_get((tok, ok)),
+                describe=f"serve prefill fetch ({req.rid})",
+            )
+        self._cache = _merge_pages(self._cache, out_cache)
+        self._span(f"prefill:{req.rid}", t0)
+        now = self.clock()
+        st.t_first = now
+        st.token_times.append(now)
+        st.generated.append(int(tok))
+        self._slot_keys = self._slot_keys.at[st.slot].set(
+            jax.random.key(req.seed)
+        )
+        self._slot_tokens[st.slot] = int(tok)
+        return bool(ok)
+
+    def _run_decode(self, sched: Scheduler) -> None:
+        active = sched.active()
+        ns = self.config.num_slots
+        table = np.full(
+            (ns, self.config.max_blocks_per_slot), SCRATCH_BLOCK, np.int32
+        )
+        lens = np.zeros((ns,), np.int32)
+        positions = np.ones((ns,), np.int32)
+        poison = np.zeros((ns,), bool)
+        for slot, st in active:
+            table[slot] = sched.allocator.table_row(st.blocks)
+            lens[slot] = st.cached_len
+            positions[slot] = st.cached_len + 1
+            poison[slot] = chaos.poison_request(
+                st.request.rid, len(st.generated)
+            )
+        t0 = self._ts_us()
+        with self._mesh_ctx():
+            out_cache, nxt, ok = _decode_step(
+                self.model, self.params,
+                _with_tables(
+                    self._cache, jnp.asarray(table), jnp.asarray(lens)
+                ),
+                jnp.asarray(self._slot_tokens), self._slot_keys,
+                jnp.asarray(positions), jnp.asarray(poison),
+                **self._static_kw(),
+            )
+            nxt, ok = with_retries(
+                lambda: jax.device_get((nxt, ok)),
+                describe="serve decode fetch",
+            )
+        self._cache = out_cache
+        self._span("decode_step", t0)
+        now = self.clock()
+        for slot, st in active:
+            req = st.request
+            if not bool(ok[slot]):
+                # bad-request isolation: evict THIS request, not the batch
+                sched.finish(
+                    st, "error", now=now,
+                    error="nonfinite logits at generated token "
+                          f"{len(st.generated)}",
+                )
+                self._span_request(st)
+                continue
+            tok = int(nxt[slot])
+            st.generated.append(tok)
+            st.token_times.append(now)
+            self._slot_tokens[slot] = tok
+            if (
+                (req.eos_id is not None and tok == req.eos_id)
+                or len(st.generated) >= req.max_new_tokens
+            ):
+                sched.finish(st, "done", now=now)
+                self._span_request(st)
+
+    def _span_request(self, st: RequestState) -> None:
+        if self.trace is None:
+            return
+        us = lambda t: int(t * 1e6)  # noqa: E731
+        rid = st.request.rid
+        self.trace.add_complete(
+            f"queue:{rid}", us(st.t_submit), us(st.t_admit) - us(st.t_submit)
+        )
+        self.trace.add_complete(
+            f"decode:{rid}", us(st.t_first), us(st.t_done) - us(st.t_first)
+        )
+
+    # -- the serving loop -------------------------------------------------
+
+    def run(self, requests: Sequence[Request], *,
+            mode: Optional[str] = None) -> dict:
+        """Serve an open-loop workload to completion; returns per-request
+        results plus aggregate latency/throughput metrics."""
+        sched = Scheduler(self.config, mode=mode or self.mode)
+        pending = sorted(requests, key=lambda r: (r.arrival, r.rid))
+        states: Dict[str, RequestState] = {}
+        next_arrival = 0
+        t_start = self.clock()
+        decode_steps = 0
+        occupied_rows = 0
+
+        while True:
+            now = self.clock()
+            while (
+                next_arrival < len(pending)
+                and pending[next_arrival].arrival <= now
+            ):
+                req = pending[next_arrival]
+                states[req.rid] = sched.submit(req, now)
+                next_arrival += 1
+            for st in sched.admit(now):
+                ok = self._run_prefill(st, sched.allocator)
+                req, tok = st.request, st.generated[-1]
+                if not ok:
+                    sched.finish(
+                        st, "error", now=self.clock(),
+                        error="nonfinite logits at prefill",
+                    )
+                    self._span_request(st)
+                elif (
+                    (req.eos_id is not None and tok == req.eos_id)
+                    or req.max_new_tokens <= 1
+                ):
+                    sched.finish(st, "done", now=self.clock())
+                    self._span_request(st)
+
+            active = sched.active()
+            if not active:
+                if not sched.queue and next_arrival >= len(pending):
+                    break  # drained
+                if next_arrival < len(pending) and not sched.queue:
+                    self.sleep(
+                        max(pending[next_arrival].arrival - self.clock(), 0.0)
+                        + 1e-4
+                    )
+                    continue
+                if sched.queue:
+                    # nothing resident yet nothing admitted: the head
+                    # request is stuck — impossible unless bookkeeping
+                    # leaked blocks; fail loudly rather than spin
+                    raise RuntimeError(
+                        "scheduler deadlock: queued requests but no "
+                        "admissible slot on an empty batch"
+                    )
+                continue
+
+            # decode boundary: grow each resident row's table; preempt the
+            # youngest resident until the growth fits
+            for slot, st in list(active):
+                while st.status == "running" and not sched.grow(st):
+                    victim = sched.preempt_youngest()
+                    if victim is None or victim is st:
+                        break
+            active = sched.active()
+            if not active:
+                continue
+            self._run_decode(sched)
+            decode_steps += 1
+            occupied_rows += len(active)
+
+        elapsed = max(self.clock() - t_start, 1e-9)
+        return self._report(
+            states, sched, elapsed, decode_steps, occupied_rows
+        )
+
+    def _report(self, states, sched, elapsed, decode_steps, occupied_rows):
+        results = {}
+        ttft, tpot = [], []
+        generated = 0
+        for rid, st in sorted(states.items()):
+            results[rid] = {
+                "status": st.status,
+                "prompt_len": st.prompt_len,
+                "tokens": list(st.generated),
+                "error": st.error,
+                "preemptions": st.preemptions,
+                "ttft_s": (
+                    st.t_first - st.t_submit if st.t_first else None
+                ),
+            }
+            if st.status in ("done", "error"):
+                generated += len(st.generated)
+                if st.t_first:
+                    ttft.append((st.t_first - st.t_submit) * 1e3)
+                tpot.extend(
+                    (b - a) * 1e3 for a, b in zip(
+                        st.token_times, st.token_times[1:]
+                    )
+                )
+        metrics = {
+            **sched.counters,
+            "elapsed_s": elapsed,
+            "decode_steps": decode_steps,
+            "generated_tokens": generated,
+            "tokens_per_sec": generated / elapsed,
+            "slot_occupancy": (
+                occupied_rows / (decode_steps * self.config.num_slots)
+                if decode_steps else 0.0
+            ),
+            "ttft_ms": _percentiles(ttft),
+            "tpot_ms": _percentiles(tpot),
+        }
+        return {"results": results, "metrics": metrics}

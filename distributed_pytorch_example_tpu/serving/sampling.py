@@ -1,0 +1,70 @@
+"""Shared sampling math for generate() and the serving engine.
+
+The truncation (temperature / top-k / top-p) is ONE implementation used
+by both decode paths — ``train/generate.py`` ``_sample`` (one rng for
+the whole batch, split per step) and the engine's per-request keys —
+so paged serving reproduces ``generate()`` token-for-token when both
+fold the rng the same way.
+
+The engine's rng contract: request ``seed`` -> ``jax.random.key(seed)``,
+and the key for the token at absolute position ``p`` (0-based, prompt
+included) is ``fold_in(key, p)``. ``generate(rng_fold="position")``
+applies the identical folding, which is what makes seeded-sampling
+equivalence exact rather than merely distributional.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def truncate_logits(
+    logits: jax.Array,
+    temperature: float,
+    top_k: Optional[int],
+    top_p: Optional[float],
+) -> jax.Array:
+    """Temperature-scale and truncate (..., V) logits; temperature > 0."""
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # whose mass reaches top_p (the first token always survives)
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cut = jnp.sum(cum - probs < top_p, axis=-1, keepdims=True)  # >= 1
+        threshold = jnp.take_along_axis(sorted_logits, cut - 1, axis=-1)
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return logits
+
+
+def sample_rows(
+    logits: jax.Array,
+    keys: jax.Array,
+    temperature: float,
+    top_k: Optional[int],
+    top_p: Optional[float],
+) -> jax.Array:
+    """Sample one token per row from (B, V) logits with per-row keys (B,).
+
+    ``temperature == 0`` is greedy argmax (keys unused). The vmapped
+    per-row categorical draws the same bits as ``categorical(key, (1, V))``
+    on a one-row batch — the property the paged-vs-contiguous sampling
+    equivalence tests pin down.
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = truncate_logits(logits, temperature, top_k, top_p)
+    return jax.vmap(jax.random.categorical)(keys, logits).astype(jnp.int32)
+
+
+def fold_keys(keys: jax.Array, positions: jax.Array) -> jax.Array:
+    """Per-row step keys: fold each row's absolute token position into its
+    request key (see module docstring for the contract)."""
+    return jax.vmap(jax.random.fold_in)(keys, positions)

@@ -36,25 +36,16 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distributed_pytorch_example_tpu.serving.sampling import truncate_logits
+
 
 def _sample(logits, rng, temperature: float, top_k: Optional[int],
             top_p: Optional[float]):
-    """One sampling step on (B, V) logits."""
+    """One sampling step on (B, V) logits (truncation math shared with
+    the serving engine — serving/sampling.py)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
-    if top_k is not None:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p is not None:
-        # nucleus: keep the smallest prefix of the sorted distribution
-        # whose mass reaches top_p (the first token always survives)
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        cut = jnp.sum(cum - probs < top_p, axis=-1, keepdims=True)  # >= 1
-        threshold = jnp.take_along_axis(sorted_logits, cut - 1, axis=-1)
-        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    logits = truncate_logits(logits, temperature, top_k, top_p)
     return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 
@@ -81,11 +72,11 @@ def _constrain_cache(cache, mesh, batch_axes: Tuple):
     jax.jit,
     static_argnums=(0, 3),
     static_argnames=("temperature", "top_k", "top_p", "eos_id", "mesh",
-                     "batch_axes"),
+                     "batch_axes", "rng_fold"),
 )
 def _generate_jit(model, params, prompt, max_new_tokens, rng, *,
                   temperature, top_k, top_p, eos_id, mesh=None,
-                  batch_axes=()):
+                  batch_axes=(), rng_fold="split"):
     batch, prompt_len = prompt.shape
     cache_len = prompt_len + max_new_tokens
     # size the caches on a full-length dummy (params from init are unused)
@@ -101,16 +92,25 @@ def _generate_jit(model, params, prompt, max_new_tokens, rng, *,
         {"params": params, "cache": cache}, prompt, train=False,
         mutable=["cache"],
     )
-    rng, sub = jax.random.split(rng)
+    if rng_fold == "position":
+        # serving-engine contract (serving/sampling.py): the token at
+        # absolute position p is drawn with fold_in(key, p); the first
+        # sampled token sits right after the prompt, at p = prompt_len
+        sub = jax.random.fold_in(rng, prompt_len)
+    else:
+        rng, sub = jax.random.split(rng)
     first = _sample(logits[:, -1], sub, temperature, top_k, top_p)
     done0 = (
         first == eos_id if eos_id is not None
         else jnp.zeros((batch,), bool)
     )
 
-    def step(carry, _):
+    def step(carry, pos):
         cache, tok, done, rng = carry
-        rng, sub = jax.random.split(rng)
+        if rng_fold == "position":
+            sub = jax.random.fold_in(rng, pos)
+        else:
+            rng, sub = jax.random.split(rng)
         logits, vars_ = model.apply(
             {"params": params, "cache": cache}, tok[:, None], train=False,
             mutable=["cache"],
@@ -123,8 +123,8 @@ def _generate_jit(model, params, prompt, max_new_tokens, rng, *,
         return (vars_["cache"], nxt, done, rng), nxt
 
     (_, _, _, _), rest = jax.lax.scan(
-        step, (vars_["cache"], first, done0, rng), None,
-        length=max_new_tokens - 1,
+        step, (vars_["cache"], first, done0, rng),
+        prompt_len + 1 + jnp.arange(max_new_tokens - 1),
     )
     new_tokens = jnp.concatenate([first[:, None], rest.T], axis=1)
     return jnp.concatenate([prompt, new_tokens], axis=1)
@@ -142,6 +142,7 @@ def generate(
     eos_id: Optional[int] = None,
     rng: Optional[jax.Array] = None,
     partitioner=None,
+    rng_fold: str = "split",
 ) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` (B, P) int32.
 
@@ -156,7 +157,17 @@ def generate(
     (TP-sharded weights stay sharded), the prompt batch shards over the
     data axes, and the KV caches shard to match. Without it the decode is
     single-logical-device (params as given).
+
+    ``rng_fold``: how per-step sampling keys derive from ``rng`` —
+    ``"split"`` (default, the historical split-per-step chain) or
+    ``"position"`` (``fold_in(rng, absolute_token_position)``, the
+    serving engine's contract; lets paged serving reproduce this
+    function token-for-token under seeded sampling).
     """
+    if rng_fold not in ("split", "position"):
+        raise ValueError(
+            f"rng_fold must be 'split' or 'position', got {rng_fold!r}"
+        )
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     if top_p is not None and not 0.0 < top_p <= 1.0:
@@ -182,6 +193,7 @@ def generate(
         return _generate_jit(
             model, params, prompt, max_new_tokens, rng,
             temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
+            rng_fold=rng_fold,
         )
     mesh = partitioner.mesh
     batch_axes = partitioner.batch_spec()[0]
@@ -202,5 +214,5 @@ def generate(
         return _generate_jit(
             model, params, prompt, max_new_tokens, rng,
             temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
-            mesh=mesh, batch_axes=batch_axes,
+            mesh=mesh, batch_axes=batch_axes, rng_fold=rng_fold,
         )

@@ -38,6 +38,11 @@ matrix runs under ``-m slow``):
                         last intact checkpoint under ``DPX_ELASTIC=1``;
                         the post-resume loss trajectory must match an
                         uninterrupted dp4 run batch-for-batch.
+- ``poison-request`` *  Serving (graft-serve): one request's logits go
+                        NaN mid-stream; the engine evicts THAT request
+                        with an error status at the next decode
+                        boundary, and the co-resident requests' outputs
+                        are bit-identical to an uninjected replay.
 
 Usage:
   python scripts/chaos_sweep.py [--fast] [--scenarios a,b,...]
@@ -59,7 +64,7 @@ if REPO_ROOT not in sys.path:
 
 FAST = (
     "nan-skip", "corrupt-latest", "io-flake", "rendezvous-flake",
-    "kill-slice",
+    "kill-slice", "poison-request",
 )
 SLOW = (
     "inf-skip", "budget-rollback", "truncate-shard", "torn-save-kill",
@@ -480,6 +485,69 @@ def scenario_kill_slice() -> dict:
     }
 
 
+def scenario_poison_request() -> dict:
+    """NaN-logits request mid-stream (graft-serve): evicted with an error
+    status; co-resident requests' outputs bit-identical to an uninjected
+    replay (per-row attention + per-request position-folded rng share no
+    cross-row state, and the block allocator is a deterministic LIFO)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+    from distributed_pytorch_example_tpu.robustness import chaos
+    from distributed_pytorch_example_tpu.serving import (
+        InferenceEngine, Request,
+    )
+
+    kw = dict(vocab_size=61, max_len=32, model_dim=16, num_layers=1,
+              num_heads=2, mlp_dim=32)
+    params = GPT2(**kw).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    model = GPT2(**kw, decode=True, paged_num_blocks=16,
+                 paged_block_size=4, paged_max_blocks=4)
+    rng = np.random.default_rng(0)
+    requests = [
+        Request(rid=f"r{i}", prompt=[int(t) for t in rng.integers(0, 61, n)],
+                max_new_tokens=8, seed=i)
+        for i, n in enumerate((6, 5, 7))
+    ]
+
+    def replay(faults):
+        engine = InferenceEngine(
+            model, params, num_slots=3, temperature=1.0, top_k=5,
+        )
+        chaos.install(chaos.ChaosPlan(faults=faults))
+        try:
+            return engine.run(requests)
+        finally:
+            chaos.uninstall()
+
+    clean = replay([])
+    fault = chaos.Fault("poison-request", at="r1", step=3)
+    hit = replay([fault])
+
+    poisoned = hit["results"]["r1"]
+    co_identical = all(
+        hit["results"][r]["tokens"] == clean["results"][r]["tokens"]
+        and clean["results"][r]["status"] == "done"
+        for r in ("r0", "r2")
+    )
+    return {
+        "ok": (
+            poisoned["status"] == "error" and fault.fired >= 1
+            and hit["metrics"]["errored"] == 1
+            and hit["metrics"]["completed"] == 2 and co_identical
+        ),
+        "action": "evict-poisoned-request",
+        "poisoned_status": poisoned["status"],
+        "poisoned_error": poisoned["error"],
+        "tokens_before_eviction": len(poisoned["tokens"]),
+        "co_resident_bit_identical": co_identical,
+    }
+
+
 SCENARIOS = {
     "nan-skip": lambda: scenario_poison_skip("nan-batch"),
     "inf-skip": lambda: scenario_poison_skip("inf-batch"),
@@ -491,6 +559,7 @@ SCENARIOS = {
     "torn-save-kill": scenario_torn_save_kill,
     "sigint": scenario_sigint,
     "kill-slice": scenario_kill_slice,
+    "poison-request": scenario_poison_request,
 }
 assert set(SCENARIOS) == set(ALL)
 

@@ -1,0 +1,224 @@
+#!/usr/bin/env python3
+"""graft-serve driver: seeded open-loop load over the paged-KV engine.
+
+Spins up an :class:`InferenceEngine` (paged KV cache + continuous
+batching, ``distributed_pytorch_example_tpu/serving/``) on a randomly
+initialized GPT-2/LLaMA of CLI-chosen size and drives it with a seeded
+Poisson open-loop workload of mixed prompt/output lengths — the standard
+serving-benchmark shape: requests arrive on their own schedule whether or
+not the server is keeping up.
+
+Driver contract (same as bench.py): stdout gets exactly ONE JSON line —
+TTFT and per-output-token latency p50/p95/p99, tokens/sec, slot
+occupancy, preempted/rejected counts, config. Per-request detail lines
+go to stderr as requests finish.
+
+Run it on the fake CPU mesh (no TPU needed)::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+      python serve.py --requests 16 --rate 4 --mesh data=2,fsdp=2,tensor=2
+
+``--mesh`` serves sharded exactly like ``generate(partitioner=...)``:
+TP-partitioned weights stay sharded, the KV pool shards kv-heads over
+``tensor`` and pool blocks over the data axes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _parse_range(spec: str, flag: str):
+    try:
+        lo, hi = (int(x) for x in spec.split(":"))
+    except ValueError:
+        raise SystemExit(f"{flag} wants LO:HI, got {spec!r}")
+    if lo < 1 or hi < lo:
+        raise SystemExit(f"{flag} wants 1 <= LO <= HI, got {spec!r}")
+    return lo, hi
+
+
+def build_requests(args):
+    """The seeded workload: Poisson arrivals, uniform mixed lengths."""
+    import numpy as np
+
+    from distributed_pytorch_example_tpu.serving import Request
+
+    rng = np.random.default_rng(args.seed)
+    plo, phi = _parse_range(args.prompt_len, "--prompt-len")
+    olo, ohi = _parse_range(args.max_new, "--max-new")
+    arrivals = (
+        np.cumsum(rng.exponential(1.0 / args.rate, args.requests))
+        if args.rate > 0 else np.zeros(args.requests)
+    )
+    reqs = []
+    for i in range(args.requests):
+        plen = int(rng.integers(plo, phi + 1))
+        reqs.append(Request(
+            rid=f"req{i:04d}",
+            prompt=[int(t) for t in rng.integers(0, args.vocab_size, plen)],
+            max_new_tokens=int(rng.integers(olo, ohi + 1)),
+            seed=args.seed * 100_003 + i,
+            arrival=float(arrivals[i]),
+        ))
+    return reqs
+
+
+def build_engine(args, trace):
+    import jax
+    import jax.numpy as jnp
+
+    paged = dict(
+        paged_num_blocks=args.num_blocks,
+        paged_block_size=args.block_size,
+        paged_max_blocks=args.max_blocks,
+    )
+    kw = dict(
+        vocab_size=args.vocab_size, max_len=args.max_len,
+        model_dim=args.model_dim, num_layers=args.num_layers,
+        num_heads=args.num_heads, mlp_dim=2 * args.model_dim,
+    )
+    if args.family == "llama":
+        from distributed_pytorch_example_tpu.models.llama import Llama as M
+
+        kw["num_kv_heads"] = args.num_kv_heads or args.num_heads
+    else:
+        from distributed_pytorch_example_tpu.models.gpt2 import GPT2 as M
+
+    model = M(**kw, decode=True, **paged)
+    # random-init params: this driver exercises serving (scheduling,
+    # latency, isolation), not text quality; a trained checkpoint's params
+    # drop in unchanged (same tree as the training model)
+    params = M(**kw).init(
+        jax.random.key(args.seed), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    partitioner = None
+    if args.mesh:
+        from distributed_pytorch_example_tpu.parallel.partition import (
+            transformer_partitioner,
+        )
+        from distributed_pytorch_example_tpu.runtime import (
+            MeshSpec, make_mesh,
+        )
+
+        axes = dict(
+            (k, int(v)) for k, v in
+            (kv.split("=") for kv in args.mesh.split(","))
+        )
+        partitioner = transformer_partitioner(make_mesh(MeshSpec(**axes)))
+
+    from distributed_pytorch_example_tpu.serving import InferenceEngine
+
+    return InferenceEngine(
+        model, params, num_slots=args.slots, temperature=args.temperature,
+        top_k=args.top_k, top_p=args.top_p, partitioner=partitioner,
+        trace=trace, mode=args.mode,
+    )
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--family", default="gpt2",
+                        choices=("gpt2", "llama"))
+    parser.add_argument("--vocab-size", type=int, default=256)
+    parser.add_argument("--max-len", type=int, default=128)
+    parser.add_argument("--model-dim", type=int, default=64)
+    parser.add_argument("--num-layers", type=int, default=2)
+    parser.add_argument("--num-heads", type=int, default=4)
+    parser.add_argument("--num-kv-heads", type=int, default=0,
+                        help="llama GQA kv heads (0 = num-heads)")
+    parser.add_argument("--slots", type=int, default=4,
+                        help="decode batch rows (the fixed slot array)")
+    parser.add_argument("--num-blocks", type=int, default=64,
+                        help="KV pool blocks per layer (incl. scratch)")
+    parser.add_argument("--block-size", type=int, default=8,
+                        help="tokens per pool block")
+    parser.add_argument("--max-blocks", type=int, default=16,
+                        help="page-table width (max context / block size)")
+    parser.add_argument("--requests", type=int, default=32)
+    parser.add_argument("--rate", type=float, default=8.0,
+                        help="Poisson arrival rate, req/s (0 = all at t=0)")
+    parser.add_argument("--prompt-len", default="4:24", metavar="LO:HI",
+                        help="uniform prompt-length range")
+    parser.add_argument("--max-new", default="8:32", metavar="LO:HI",
+                        help="uniform output-length range")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--temperature", type=float, default=1.0,
+                        help="0 = greedy")
+    parser.add_argument("--top-k", type=int, default=None)
+    parser.add_argument("--top-p", type=float, default=None)
+    parser.add_argument("--mode", default="continuous",
+                        choices=("continuous", "static"),
+                        help="static = classic wave batching (admit only "
+                        "when every slot drained)")
+    parser.add_argument("--mesh", default="",
+                        help="serve sharded, e.g. data=2,fsdp=2,tensor=2 "
+                        "(axes product must equal the device count)")
+    parser.add_argument("--trace", default=None, metavar="PATH",
+                        help="write per-request Chrome trace spans here")
+    args = parser.parse_args()
+    if args.requests < 1:
+        parser.error("--requests must be >= 1")
+    if args.max_blocks * args.block_size > args.max_len:
+        parser.error("--max-blocks * --block-size must be <= --max-len")
+
+    from distributed_pytorch_example_tpu.telemetry.trace import TraceWriter
+
+    trace = TraceWriter(args.trace)
+    engine = build_engine(args, trace)
+    requests = build_requests(args)
+    import jax
+
+    print(
+        f"serve: {args.family} on {len(jax.devices())} "
+        f"{jax.devices()[0].platform} device(s), {args.requests} requests, "
+        f"rate={args.rate}/s, mode={args.mode}, slots={args.slots}, "
+        f"pool={args.num_blocks}x{args.block_size}",
+        file=sys.stderr,
+    )
+    report = engine.run(requests)
+    trace.close()
+    for rid, r in sorted(report["results"].items()):
+        print(json.dumps({
+            "rid": rid, "status": r["status"],
+            "prompt_len": r["prompt_len"], "new_tokens": len(r["tokens"]),
+            "ttft_s": r["ttft_s"], "preemptions": r["preemptions"],
+            **({"error": r["error"]} if r["error"] else {}),
+        }), file=sys.stderr)
+
+    m = report["metrics"]
+    line = {
+        "metric": "serve_tokens_per_sec",
+        "value": round(m["tokens_per_sec"], 2),
+        "unit": "tokens/sec",
+        "ttft_ms": m["ttft_ms"],
+        "tpot_ms": m["tpot_ms"],
+        "slot_occupancy": round(m["slot_occupancy"], 4),
+        "decode_steps": m["decode_steps"],
+        "generated_tokens": m["generated_tokens"],
+        "elapsed_s": round(m["elapsed_s"], 3),
+        "admitted": m["admitted"],
+        "completed": m["completed"],
+        "errored": m["errored"],
+        "rejected": m["rejected"],
+        "preempted": m["preempted"],
+        "config": {
+            "family": args.family, "requests": args.requests,
+            "rate": args.rate, "mode": args.mode, "slots": args.slots,
+            "num_blocks": args.num_blocks, "block_size": args.block_size,
+            "max_blocks": args.max_blocks,
+            "prompt_len": args.prompt_len, "max_new": args.max_new,
+            "temperature": args.temperature, "top_k": args.top_k,
+            "top_p": args.top_p, "seed": args.seed,
+            **({"mesh": args.mesh} if args.mesh else {}),
+        },
+    }
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

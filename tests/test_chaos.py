@@ -541,12 +541,16 @@ def test_chaos_sweep_fast_subset_green():
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert [r["scenario"] for r in lines] == [
         "nan-skip", "corrupt-latest", "io-flake", "rendezvous-flake",
-        "kill-slice",
+        "kill-slice", "poison-request",
     ]
     assert all(r["ok"] for r in lines), lines
-    kill_slice = lines[-1]
+    by_name = {r["scenario"]: r for r in lines}
+    kill_slice = by_name["kill-slice"]
     assert kill_slice["action"] == "shrink-to-survivors-resume"
     assert kill_slice["max_loss_diff"] <= 1e-3 + 1e-4
+    poison = by_name["poison-request"]
+    assert poison["action"] == "evict-poisoned-request"
+    assert poison["co_resident_bit_identical"] is True
 
 
 @pytest.mark.slow

@@ -250,6 +250,63 @@ def test_ckpt_stamp_real_checkpoint_module_lints_clean():
     assert pylint_rules.lint_source("train/checkpoint.py", src) == []
 
 
+def test_serve_dynamic_shape_fires_on_shape_branch_and_append():
+    src = (
+        "from functools import partial\n"
+        "import jax\n"
+        "@partial(jax.jit, static_argnums=(0,))\n"
+        "def decode(model, cache, tokens):\n"
+        "    out = []\n"
+        "    if tokens.shape[1] > 1:\n"
+        "        out.append(tokens)\n"
+        "    return out\n"
+    )
+    findings = pylint_rules.lint_source("serving/engine.py", src)
+    assert _rules(findings) == [
+        "serve-dynamic-shape", "serve-dynamic-shape",
+    ]
+    assert "engine.py:6" in findings[0].where  # the .shape branch
+    assert "engine.py:7" in findings[1].where  # the .append
+
+
+def test_serve_dynamic_shape_scope_suppression_and_host_code():
+    # bare @jax.jit spelling also counts as a jitted region
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    while x.shape[0] > 1:  # graft-lint: serve-dynamic-shape\n"
+        "        x = x[1:]\n"
+        "    return x\n"
+    )
+    assert pylint_rules.lint_source("serving/engine.py", src) == []
+    # the same source outside serving/ is out of scope
+    src2 = src.replace("# graft-lint: serve-dynamic-shape", "")
+    assert pylint_rules.lint_source("serving/engine.py", src2) != []
+    assert pylint_rules.lint_source("telemetry/trace.py", src2) == []
+    # host-side (un-jitted) scheduler code appends freely
+    src3 = (
+        "def admit(queue, slots):\n"
+        "    admitted = []\n"
+        "    if len(slots) > 0:\n"
+        "        admitted.append(queue.popleft())\n"
+        "    return admitted\n"
+    )
+    assert pylint_rules.lint_source("serving/scheduler.py", src3) == []
+
+
+def test_serve_real_engine_module_lints_clean():
+    # the acceptance gate: the shipped engine keeps every shape decision
+    # on the host (tables/lens/buckets), so the jitted programs are clean
+    path = os.path.join(
+        REPO_ROOT, "distributed_pytorch_example_tpu", "serving",
+        "engine.py",
+    )
+    with open(path) as f:
+        src = f.read()
+    assert pylint_rules.lint_source("serving/engine.py", src) == []
+
+
 def test_real_instrumented_step_lints_clean():
     # the acceptance gate: the sentinel-instrumented train step passes the
     # full AST rule set (host-sync AND debug-callback) as committed
