@@ -1,0 +1,186 @@
+"""Static HBM envelopes: predicted per-chip peak bytes, before any compile.
+
+The shardflow walk (``analysis/shardflow.py``) already knows, for every
+value in the traced train step, its per-chip byte size (aval bytes over
+the propagated PartitionSpec's mesh span) and its live range (defining
+equation to last use). Summing resident inputs — params, ZeRO-1-sharded
+optimizer state, the batch — with the activation-liveness peak gives a
+STATIC upper envelope on the step's HBM residency: no lowering, no XLA.
+
+Calibration against the compiler's own accounting (``telemetry/cost.py``
+``hbm_peak_bytes`` = args + outputs + temps − aliased, from
+``compiled.memory_analysis()``) on the green dryrun configs puts the
+prediction at 2.1–3.1× measured: an upper bound, never an under-estimate
+(XLA fuses, rematerializes, and reuses buffers the abstract liveness
+keeps distinct). That band is the artifact's stated tolerance — the
+cross-validation gate fails if a prediction ever drops BELOW measured
+(the envelope would no longer be safe to gate on) or drifts above
+``RATIO_MAX`` (the estimate got too loose to mean anything).
+
+Committed as ``analysis/memory_envelopes.json`` with the jax version in
+``_meta`` so version-skew demotes the gate to a warning, exactly like
+``comm_budgets.json``. The pre-compile would-OOM gate
+(:func:`gate_envelope`) is consumed by ``__graft_entry__`` (honoring a
+``DPX_HBM_LIMIT`` env override) and by the audit runner, and is the
+memory half of the static oracle ROADMAP item 3's auto-parallelism
+planner searches over.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+# stated tolerance: predicted/measured must stay inside this band on
+# every config that compiles (prediction is a safe, not-too-loose upper
+# bound). Empirically the 7 green 8-device CPU-mesh configs sit in
+# [2.1, 3.2]; the band leaves headroom without letting the envelope lie.
+RATIO_MIN = 1.0
+RATIO_MAX = 4.0
+
+# drift tolerance for predicted-vs-committed (tracing is deterministic
+# for a fixed jax version; the slack only absorbs dtype-width noise)
+PREDICTED_REL_TOL = 0.01
+
+DEFAULT_ENVELOPES_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "memory_envelopes.json"
+)
+
+
+def predicted_envelope(report) -> Dict[str, int]:
+    """Envelope record fields from a shardflow FlowReport."""
+    return {
+        "predicted_peak_bytes": int(report.peak_bytes),
+        "arg_bytes": int(report.arg_bytes),
+        "activation_peak_bytes": int(report.live_peak_bytes),
+    }
+
+
+def envelope_record(case, report,
+                    measured_hbm_peak: Optional[int]) -> Dict[str, object]:
+    """One committed envelope entry for a dryrun/serve case."""
+    rec: Dict[str, object] = {
+        "mesh": {k: int(v) for k, v in dict(case.mesh.shape).items()},
+        **predicted_envelope(report),
+        "measured_hbm_peak_bytes": (
+            int(measured_hbm_peak) if measured_hbm_peak else None
+        ),
+    }
+    if measured_hbm_peak:
+        rec["ratio"] = round(report.peak_bytes / measured_hbm_peak, 3)
+    return rec
+
+
+def write_envelopes(path: str, records: Dict[str, Dict[str, object]],
+                    n_devices: int) -> None:
+    import jax
+
+    payload = {
+        "_meta": {
+            "jax": jax.__version__,
+            "n_devices": n_devices,
+            "ratio_band": [RATIO_MIN, RATIO_MAX],
+            "predicted_rel_tol": PREDICTED_REL_TOL,
+            "note": (
+                "predicted_peak_bytes is shardflow's per-chip liveness "
+                "upper bound; ratio = predicted/measured must stay in "
+                "ratio_band on every config that compiles"
+            ),
+        },
+        "configs": dict(sorted(records.items())),
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_envelopes(path: str = DEFAULT_ENVELOPES_PATH) -> Optional[Dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class EnvelopeViolation:
+    def __init__(self, rule: str, config: str, detail: str):
+        self.rule = rule
+        self.config = config
+        self.detail = detail
+
+    def render(self) -> str:
+        return f"[{self.rule}] {self.config}: {self.detail}"
+
+
+def compare_envelope(config: str, committed: Dict[str, object],
+                     predicted_peak: int,
+                     measured_hbm_peak: Optional[int],
+                     ) -> List[EnvelopeViolation]:
+    """Gate one config's fresh prediction/measurement against the file.
+
+    Three rules: (1) the prediction must not have drifted from the
+    committed envelope (a drift means the program's memory shape changed
+    — re-run ``--update-envelopes`` deliberately, like budget bumps);
+    (2) when a measurement exists, predicted must still be an upper bound
+    (ratio >= RATIO_MIN); (3) the bound must stay meaningful
+    (ratio <= RATIO_MAX).
+    """
+    out: List[EnvelopeViolation] = []
+    want = committed.get("predicted_peak_bytes")
+    if want:
+        drift = abs(predicted_peak - int(want)) / max(int(want), 1)
+        if drift > PREDICTED_REL_TOL:
+            out.append(EnvelopeViolation(
+                "envelope-drift", config,
+                f"predicted {predicted_peak}B vs committed {want}B "
+                f"({drift:.1%} > {PREDICTED_REL_TOL:.0%}); re-run "
+                f"--update-envelopes if the memory shape change is meant",
+            ))
+    if measured_hbm_peak:
+        ratio = predicted_peak / measured_hbm_peak
+        if ratio < RATIO_MIN:
+            out.append(EnvelopeViolation(
+                "envelope-underestimate", config,
+                f"predicted {predicted_peak}B < measured "
+                f"{measured_hbm_peak}B (ratio {ratio:.2f}): the static "
+                f"envelope is no longer a safe upper bound",
+            ))
+        elif ratio > RATIO_MAX:
+            out.append(EnvelopeViolation(
+                "envelope-slack", config,
+                f"predicted/measured ratio {ratio:.2f} above "
+                f"{RATIO_MAX:.1f}: the envelope is too loose to gate on",
+            ))
+    return out
+
+
+def gate_envelope(config: str, predicted_peak: int,
+                  hbm_limit_bytes: Optional[int],
+                  ) -> Optional[EnvelopeViolation]:
+    """The pre-compile would-OOM gate: refuse configs whose STATIC
+    envelope already exceeds the chip's HBM. Because the envelope is an
+    upper bound, a pass here is advisory; a fail is definitive."""
+    if not hbm_limit_bytes or predicted_peak <= hbm_limit_bytes:
+        return None
+    return EnvelopeViolation(
+        "would-oom", config,
+        f"static envelope {predicted_peak}B exceeds HBM limit "
+        f"{hbm_limit_bytes}B — refusing before compile",
+    )
+
+
+def hbm_limit_from_env() -> Optional[int]:
+    """``DPX_HBM_LIMIT`` in bytes (suffixes K/M/G honored), else None."""
+    raw = os.environ.get("DPX_HBM_LIMIT", "").strip()
+    if not raw:
+        return None
+    mult = 1
+    for suffix, m in (("K", 1 << 10), ("M", 1 << 20), ("G", 1 << 30)):
+        if raw.upper().endswith(suffix):
+            raw, mult = raw[:-1], m
+            break
+    try:
+        return int(float(raw) * mult)
+    except ValueError:
+        return None

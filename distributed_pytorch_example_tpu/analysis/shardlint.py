@@ -147,6 +147,7 @@ def lint_replicated_params(
     partitioner,
     min_bytes: int = DEFAULT_REPLICATED_MIN_BYTES,
     config: Optional[str] = None,
+    path_prefix: str = "",
 ) -> List[Finding]:
     """Flag large fully-replicated params that ``partitioner`` would shard.
 
@@ -155,6 +156,15 @@ def lint_replicated_params(
     at least ``min_bytes``, its committed sharding is fully replicated,
     and the rules map it to a spec that actually spans a >1-size mesh
     axis (rules landing on size-1 axes are vacuously replicated).
+
+    ``path_prefix`` prepends a tree location to every leaf path before
+    the rules are consulted — pass ``"opt_state"`` to run the rule over
+    optimizer-state trees, where ``Partitioner.spec_for`` additionally
+    applies the ZeRO-1 overlay (``parallel/api.py _OPT_STATE_RE``): a
+    large replicated Adam moment is then judged against the OVERLAID
+    spec, so opt shards the rules would dp-shard get flagged too.
+    Leaves the overlay's ``opt_shard_min_size`` floor keeps replicated
+    (strictly below the floor) resolve to a span of 1 and stay clean.
     """
     import jax
 
@@ -172,6 +182,8 @@ def lint_replicated_params(
         if sharding is None or not sharding.is_fully_replicated:
             continue
         path_str = _leaf_path_str(path)
+        if path_prefix:
+            path_str = f"{path_prefix}/{path_str}"
         spec = partitioner.spec_for(path_str, shape)
         span = 1
         for entry in spec:

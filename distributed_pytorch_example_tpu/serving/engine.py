@@ -304,6 +304,76 @@ class InferenceEngine:
         if self.trace is not None:
             self.trace.add_complete(name, t0_us, self._ts_us() - t0_us)
 
+    def lowered_programs(self) -> dict:
+        """``{"serve/prefill": lowered, "serve/decode": lowered}`` — the
+        engine's two compiled programs, lowered with representative args
+        (largest prefill bucket; full slot array) but never executed.
+
+        This is the static auditor's entry point (``analysis/runner.py``):
+        prefill and decode become first-class budget entries in
+        ``comm_budgets.json`` and get static HBM envelopes, gated exactly
+        like train configs. Lowering matches ``_run_prefill``/
+        ``_run_decode``'s call shapes, so the audited programs ARE the
+        serving programs.
+        """
+        args = self._program_args()
+        with self._mesh_ctx():
+            return {
+                name: fn.lower(self.model, *rest, **self._static_kw())
+                for name, (fn, rest) in args.items()
+            }
+
+    def traced_programs(self) -> dict:
+        """``{name: (closed_jaxpr, in_specs)}`` for the same two programs
+        — trace-only (no lowering, no backend query), for the shardflow /
+        congruence static layers. ``in_specs`` are the committed
+        PartitionSpecs of the flat traced arguments (None = replicated),
+        aligned with the jaxpr's invars."""
+        import functools
+
+        out = {}
+        args = self._program_args()
+        with self._mesh_ctx():
+            for name, (fn, rest) in args.items():
+                wrapped = functools.partial(
+                    fn, self.model, **self._static_kw()
+                )
+                jaxpr = jax.make_jaxpr(wrapped)(*rest)
+                specs = [
+                    getattr(getattr(leaf, "sharding", None), "spec", None)
+                    for leaf in jax.tree_util.tree_leaves(rest)
+                ]
+                out[name] = (jaxpr, specs)
+        return out
+
+    def _program_args(self) -> dict:
+        """Representative (jitted_fn, traced_args) per program name."""
+        ns = self.config.num_slots
+        mb = self.config.max_blocks_per_slot
+        bucket = self.prefill_buckets[-1]
+        return {
+            "serve/prefill": (_prefill_step, (
+                self.params,
+                _with_tables(
+                    self._cache,
+                    jnp.full((1, mb), SCRATCH_BLOCK, jnp.int32),
+                    jnp.zeros((1,), jnp.int32),
+                ),
+                jnp.zeros((1, bucket), jnp.int32), jax.random.key(0),
+                jnp.int32(1), jnp.asarray(False),
+            )),
+            "serve/decode": (_decode_step, (
+                self.params,
+                _with_tables(
+                    self._cache,
+                    jnp.full((ns, mb), SCRATCH_BLOCK, jnp.int32),
+                    jnp.zeros((ns,), jnp.int32),
+                ),
+                jnp.asarray(self._slot_tokens), self._slot_keys,
+                jnp.ones((ns,), jnp.int32), jnp.zeros((ns,), bool),
+            )),
+        }
+
     # -- the two programs -------------------------------------------------
 
     def _run_prefill(self, st: RequestState, alloc: BlockAllocator) -> bool:

@@ -105,6 +105,20 @@ def compiled_cost_record(compiled, device=None) -> Dict[str, object]:
     return record
 
 
+def measured_hbm_peak(compiled) -> Optional[int]:
+    """The compiler's own per-chip residency estimate for one program —
+    args + outputs + temps − aliased — or None when the backend cannot
+    answer. This is the measurement ``analysis/envelope.py`` cross-
+    validates its static predictions against."""
+    mem = _memory_analysis(compiled)
+    if {"argument_bytes", "output_bytes", "temp_bytes"} <= mem.keys():
+        return (
+            mem["argument_bytes"] + mem["output_bytes"] + mem["temp_bytes"]
+            - mem.get("alias_bytes", 0)
+        )
+    return None
+
+
 class CostRegistry:
     """Per-run registry of compile cost records, keyed by tag.
 
@@ -127,6 +141,15 @@ class CostRegistry:
 
     def get(self, tag: str) -> Optional[Dict[str, object]]:
         return self.records.get(tag)
+
+    def export(self, path: str) -> None:
+        """Dump all records as JSON (measured peaks for offline
+        cross-validation against the committed static envelopes)."""
+        import json
+
+        with open(path, "w") as f:
+            json.dump(self.records, f, indent=2, sort_keys=True, default=str)
+            f.write("\n")
 
     def mfu_analytic(
         self, tag: str, step_time_ms: Optional[float]

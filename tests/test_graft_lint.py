@@ -36,6 +36,7 @@ def _rules(findings):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.lint
 def test_host_sync_item_fires_once():
     src = (
         "def step(loss):\n"
@@ -48,6 +49,7 @@ def test_host_sync_item_fires_once():
     assert "tasks.py:3" in findings[0].where
 
 
+@pytest.mark.lint
 def test_host_sync_numpy_alias_and_device_get():
     src = (
         "import numpy as xp\n"
@@ -61,11 +63,13 @@ def test_host_sync_numpy_alias_and_device_get():
     assert _rules(findings) == ["host-sync", "host-sync"]
 
 
+@pytest.mark.lint
 def test_host_sync_outside_traced_scope_ignored():
     src = "def f(x):\n    return x.item()\n"
     assert pylint_rules.lint_source("runtime/logging.py", src) == []
 
 
+@pytest.mark.lint
 def test_host_sync_suppression_comment():
     src = (
         "def f(x):\n"
@@ -74,6 +78,7 @@ def test_host_sync_suppression_comment():
     assert pylint_rules.lint_source("ops/fused.py", src) == []
 
 
+@pytest.mark.lint
 def test_mesh_size_guess_fires_once():
     src = (
         "def guard(n, mesh):\n"
@@ -84,6 +89,7 @@ def test_mesh_size_guess_fires_once():
     assert _rules(findings) == ["mesh-size-guess"]
 
 
+@pytest.mark.lint
 def test_mesh_size_guess_mesh_shape_subscript():
     src = (
         "def guard(n, mesh):\n"
@@ -93,6 +99,7 @@ def test_mesh_size_guess_mesh_shape_subscript():
     assert _rules(findings) == ["mesh-size-guess"]
 
 
+@pytest.mark.lint
 def test_mesh_size_guess_excused_by_sharding_inspection():
     # consulting the committed layout first makes the mesh span a
     # sanctioned fallback (the fixed chunked_ce pattern)
@@ -106,6 +113,7 @@ def test_mesh_size_guess_excused_by_sharding_inspection():
     assert pylint_rules.lint_source("ops/fused.py", src) == []
 
 
+@pytest.mark.lint
 def test_mutable_default_fires_once_public_only():
     src = (
         "def public_api(x, cache={}):\n"
@@ -118,6 +126,7 @@ def test_mutable_default_fires_once_public_only():
     assert "public_api" in findings[0].message
 
 
+@pytest.mark.lint
 def test_debug_callback_fires_in_scope():
     src = (
         "import jax\n"
@@ -131,6 +140,7 @@ def test_debug_callback_fires_in_scope():
     assert "sentinel" in findings[0].message  # points at the graft-scope path
 
 
+@pytest.mark.lint
 def test_debug_callback_from_import_and_alias_forms():
     src = (
         "from jax import debug\n"
@@ -144,6 +154,7 @@ def test_debug_callback_from_import_and_alias_forms():
     assert _rules(findings) == ["debug-callback", "debug-callback"]
 
 
+@pytest.mark.lint
 def test_debug_callback_suppression_and_scope():
     src = (
         "import jax\n"
@@ -160,6 +171,7 @@ def test_debug_callback_suppression_and_scope():
     assert pylint_rules.lint_source("ops/fused.py", src3) == []
 
 
+@pytest.mark.lint
 def test_nan_launder_fires_in_scope():
     src = (
         "import jax.numpy as jnp\n"
@@ -178,6 +190,7 @@ def test_nan_launder_fires_in_scope():
     ) == ["nan-launder", "nan-launder"]
 
 
+@pytest.mark.lint
 def test_nan_launder_suppression_and_scope():
     src = (
         "import jax.numpy as jnp\n"
@@ -193,6 +206,7 @@ def test_nan_launder_suppression_and_scope():
     assert pylint_rules.lint_source("train/step.py", src3) == []
 
 
+@pytest.mark.lint
 def test_ckpt_stamp_fires_on_unstamped_serialize():
     src = (
         "from flax import serialization\n"
@@ -205,6 +219,7 @@ def test_ckpt_stamp_fires_on_unstamped_serialize():
     assert "mesh-manifest stamp" in findings[0].message
 
 
+@pytest.mark.lint
 def test_ckpt_stamp_quiet_when_manifest_threaded():
     # referencing the stamp anywhere in the enclosing function sanctions
     # the write (keyword arg, name, or the payload-key string literal)
@@ -221,6 +236,7 @@ def test_ckpt_stamp_quiet_when_manifest_threaded():
         assert pylint_rules.lint_source("train/checkpoint.py", src) == []
 
 
+@pytest.mark.lint
 def test_ckpt_stamp_suppression_and_scope():
     src = (
         "from flax import serialization\n"
@@ -238,6 +254,7 @@ def test_ckpt_stamp_suppression_and_scope():
     assert pylint_rules.lint_source("analysis/export.py", src2) == []
 
 
+@pytest.mark.lint
 def test_ckpt_stamp_real_checkpoint_module_lints_clean():
     # the acceptance gate: every committed checkpoint writer threads the
     # format-3 stamp (graft-elastic), so the shipped module has no findings
@@ -250,6 +267,7 @@ def test_ckpt_stamp_real_checkpoint_module_lints_clean():
     assert pylint_rules.lint_source("train/checkpoint.py", src) == []
 
 
+@pytest.mark.lint
 def test_serve_dynamic_shape_fires_on_shape_branch_and_append():
     src = (
         "from functools import partial\n"
@@ -269,6 +287,7 @@ def test_serve_dynamic_shape_fires_on_shape_branch_and_append():
     assert "engine.py:7" in findings[1].where  # the .append
 
 
+@pytest.mark.lint
 def test_serve_dynamic_shape_scope_suppression_and_host_code():
     # bare @jax.jit spelling also counts as a jitted region
     src = (
@@ -295,6 +314,7 @@ def test_serve_dynamic_shape_scope_suppression_and_host_code():
     assert pylint_rules.lint_source("serving/scheduler.py", src3) == []
 
 
+@pytest.mark.lint
 def test_serve_real_engine_module_lints_clean():
     # the acceptance gate: the shipped engine keeps every shape decision
     # on the host (tables/lens/buckets), so the jitted programs are clean
@@ -307,6 +327,7 @@ def test_serve_real_engine_module_lints_clean():
     assert pylint_rules.lint_source("serving/engine.py", src) == []
 
 
+@pytest.mark.lint
 def test_real_instrumented_step_lints_clean():
     # the acceptance gate: the sentinel-instrumented train step passes the
     # full AST rule set (host-sync AND debug-callback) as committed
@@ -318,6 +339,7 @@ def test_real_instrumented_step_lints_clean():
     assert pylint_rules.lint_source("train/step.py", src) == []
 
 
+@pytest.mark.lint
 def test_clean_package_zero_ast_findings():
     assert pylint_rules.lint_package() == []
 
@@ -341,6 +363,7 @@ ENTRY main {
 """
 
 
+@pytest.mark.lint
 def test_parse_collectives_counts_and_bytes():
     got = coll.parse_collectives(_HLO_FIXTURE)
     # the `reduce(... %all-reduce ...)` operand must NOT count as a second
@@ -354,6 +377,7 @@ def test_parse_collectives_counts_and_bytes():
     assert "reduce" not in got  # plain reduce is not a collective
 
 
+@pytest.mark.lint
 def test_alias_parse():
     assert shardlint.aliased_parameter_numbers(_HLO_FIXTURE) == {0, 2}
     assert shardlint.aliased_parameter_numbers(
@@ -361,6 +385,7 @@ def test_alias_parse():
     ) is None
 
 
+@pytest.mark.lint
 def test_compare_budgets_count_increase_is_violation():
     committed = {"all-reduce": {"count": 2, "bytes": 100}}
     measured = {"all-reduce": {"count": 3, "bytes": 100}}
@@ -369,6 +394,7 @@ def test_compare_budgets_count_increase_is_violation():
     assert v[0].config == "cfg" and v[0].where == "all-reduce"
 
 
+@pytest.mark.lint
 def test_compare_budgets_byte_tolerance():
     committed = {"all-gather": {"count": 1, "bytes": 1000}}
     within = {"all-gather": {"count": 1, "bytes": 1040}}
@@ -378,6 +404,7 @@ def test_compare_budgets_byte_tolerance():
     assert _rules(v) == ["comm-budget-bytes"]
 
 
+@pytest.mark.lint
 def test_compare_budgets_new_kind_and_improvement():
     committed = {"all-reduce": {"count": 2, "bytes": 100}}
     measured = {
@@ -390,6 +417,7 @@ def test_compare_budgets_new_kind_and_improvement():
     assert any("improvement" in n for n in notes)  # the decrease is a note
 
 
+@pytest.mark.lint
 def test_parse_markers_greps_named_scopes():
     text = (
         'HloModule m\n fusion.1 = f32[4]{0} fusion(...), metadata='
@@ -400,6 +428,7 @@ def test_parse_markers_greps_named_scopes():
     }
 
 
+@pytest.mark.lint
 def test_compare_budgets_stash_signature():
     """The 1f1b-stash structural contract: the stash marker must be
     present and the recompute marker absent — byte/count budgets cannot
@@ -521,6 +550,37 @@ def test_replicated_large_param_seeded(mesh_2x2x2):
     ) == []
 
 
+def test_replicated_opt_state_zero1_floor_boundary(mesh_2x2x2):
+    """The ZeRO-1 overlay's size floor is strict: a moment EXACTLY at
+    ``opt_shard_min_size`` elements is sharded by the overlay (so its
+    replicated placement is flagged); one element under the floor stays
+    replicated BY DESIGN and must not be flagged. Guards the `<` in
+    ``parallel/api.py zero1_dim`` against an off-by-one regression."""
+    from distributed_pytorch_example_tpu.parallel.api import data_parallel
+
+    n = 128 * 128  # leaf element count, 64 KiB f32
+    moment = jax.device_put(
+        jnp.zeros((128, 128), jnp.float32), NamedSharding(mesh_2x2x2, P())
+    )
+    opt_state = {"mu": {"decoder": {"mlp": {"wi": {"kernel": moment}}}}}
+
+    at_floor = data_parallel(
+        mesh_2x2x2, dp_shard_opt_state=True, opt_shard_min_size=n
+    )
+    findings = shardlint.lint_replicated_params(
+        opt_state, at_floor, min_bytes=1024, path_prefix="opt_state"
+    )
+    assert _rules(findings) == ["replicated-large-param"]
+    assert findings[0].where.startswith("opt_state/")
+
+    above_floor = data_parallel(
+        mesh_2x2x2, dp_shard_opt_state=True, opt_shard_min_size=n + 1
+    )
+    assert shardlint.lint_replicated_params(
+        opt_state, above_floor, min_bytes=1024, path_prefix="opt_state"
+    ) == []
+
+
 # ---------------------------------------------------------------------------
 # collective budget gate: one cheap config in tier-1, perturbation check
 # ---------------------------------------------------------------------------
@@ -585,12 +645,15 @@ def test_budget_gate_catches_widened_sharding(devices):
     assert all(f.where in coll.COLLECTIVE_KINDS for f in violations)
 
 
+@pytest.mark.lint
 def test_budget_file_covers_all_configs():
     sys.path.insert(0, REPO_ROOT)
     import __graft_entry__ as entry
 
     budgets = coll.load_budgets()
     names = {entry.dryrun_config_name(c) for c in entry.DRYRUN_CONFIGS}
+    # the serving engine's programs are first-class budget entries
+    names |= {"serve/prefill", "serve/decode"}
     assert set(budgets["configs"]) == names
     meta = budgets["_meta"]
     assert meta["n_devices"] == 8 and "jax" in meta
