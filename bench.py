@@ -183,9 +183,51 @@ def run_serve(args) -> dict:
     # untimed warmup replay compiles the two programs (and the per-bucket
     # prefill variants); the timed replays then measure steady state
     engine.run(requests)
-    reports = {m: engine.run(requests, mode=m)["metrics"]
-               for m in ("continuous", "static")}
-    cont, stat = reports["continuous"], reports["static"]
+    cont_full = engine.run(requests, mode="continuous")
+    cont = cont_full["metrics"]
+    stat = engine.run(requests, mode="static")["metrics"]
+
+    fleet = None
+    if getattr(args, "replicas", 1) > 1:
+        # graft-fleet replay: the SAME workload through N replicas behind
+        # the failover router; position-folded rng means the fleet output
+        # must be bit-identical to the single-engine run above
+        from distributed_pytorch_example_tpu.serving import (
+            FleetRouter, ReplicaHandle,
+        )
+
+        engines = [
+            InferenceEngine(
+                model, params, num_slots=slots, temperature=1.0, top_k=40,
+            )
+            for _ in range(args.replicas)
+        ]
+        handles = [
+            ReplicaHandle(f"r{i}", e) for i, e in enumerate(engines)
+        ]
+        frep = FleetRouter(handles).run(requests)
+        fm = frep["metrics"]
+        exact = all(
+            frep["results"][r.rid]["tokens"]
+            == cont_full["results"][r.rid]["tokens"]
+            for r in requests
+        )
+        fleet = {
+            "replicas": args.replicas,
+            "tokens_per_sec_per_chip": round(
+                fm["tokens_per_sec"] / n_chips, 2
+            ),
+            "completed": fm["completed"],
+            "token_exact_vs_single_engine": exact,
+            "steady_per_row_ms": (
+                round(fm["steady_per_row_ms"], 3)
+                if fm["steady_per_row_ms"] is not None else None
+            ),
+            "per_replica_occupancy": {
+                rep: round(stats["occupancy"], 4)
+                for rep, stats in fm["per_replica"].items()
+            },
+        }
 
     rate = cont["tokens_per_sec"] / n_chips
     result = {
@@ -207,6 +249,7 @@ def run_serve(args) -> dict:
             "static": stat["decode_steps"],
         },
         "completed": cont["completed"],
+        **({"fleet": fleet} if fleet is not None else {}),
         "config": {
             "requests": n_requests, "slots": slots,
             "num_blocks": pool["paged_num_blocks"],
@@ -556,6 +599,11 @@ def main():
                         "stdout line carries continuous tokens/sec/chip "
                         "plus TTFT percentiles and the continuous/static "
                         "margin")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="with --serve: additionally replay the same "
+                        "workload through N fleet replicas behind the "
+                        "failover router (graft-fleet) and report fleet "
+                        "throughput + bit-exactness vs the single engine")
     parser.add_argument("--chaos", default="none",
                         choices=("none", "nan-step", "io-flake"),
                         help="post-timing fault-injection demo (graft-"

@@ -1,6 +1,6 @@
 """Repo-specific Python AST lints (no jax import, no backend).
 
-Eight rules, each a distilled past-regression class:
+Nine rules, each a distilled past-regression class:
 
 - ``host-sync``: ``.item()`` / ``np.asarray`` / ``jax.device_get`` inside
   TRACED-SCOPE sources (``ops/``, ``models/``, ``parallel/``,
@@ -59,6 +59,17 @@ Eight rules, each a distilled past-regression class:
   appending tokens to a Python list inside the traced region either
   fails tracing or unrolls the loop. Variable length belongs in the
   HOST scheduler (tables, lens, buckets), never in the traced step.
+
+- ``fleet-unbounded-wait``: a zero-argument ``.get()`` / ``.wait()`` /
+  ``.join()`` call (no positional timeout, no ``timeout=`` keyword)
+  inside ``serving/``. graft-fleet's failover contract is that every
+  blocking wait in the serving path is deadline-bounded — an unbounded
+  ``queue.get()`` in a replica worker or ``Event.wait()`` in the router
+  is exactly the silent-hang class the heartbeat deadline exists to
+  catch, and a hang INSIDE the detector is undetectable. Calls with any
+  positional argument never fire (``dict.get(key)``, ``sep.join(xs)``,
+  ``event.wait(0.05)`` are all fine), and ``block=False`` non-blocking
+  gets are fine; everything else must pass ``timeout=``.
 
 Scope is static and name-based, not a whole-program call graph — the
 cheap 99% of the check. Deliberate exceptions carry a
@@ -355,6 +366,45 @@ def _serve_dynamic_shape_findings(
     return [flagged[k] for k in sorted(flagged)]
 
 
+_WAIT_NAMES = ("get", "wait", "join")
+
+
+def _fleet_unbounded_wait_findings(
+    tree: ast.Module, relpath: str, supp: Dict[int, Set[str]]
+) -> List[Finding]:
+    """Unbounded blocking waits in the fleet/serving path (module doc)."""
+    flagged: Dict[int, Finding] = {}  # keyed by line: nesting dedup
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _WAIT_NAMES
+        ):
+            continue
+        if node.args:
+            continue  # positional timeout / dict.get(key) / sep.join(xs)
+        kwargs = {k.arg: k.value for k in node.keywords if k.arg}
+        if "timeout" in kwargs:
+            continue
+        block = kwargs.get("block")
+        if isinstance(block, ast.Constant) and block.value is False:
+            continue  # non-blocking get never waits
+        if _suppressed(supp, node.lineno, "fleet-unbounded-wait"):
+            continue
+        flagged.setdefault(node.lineno, Finding(
+            rule="fleet-unbounded-wait",
+            where=f"{relpath}:{node.lineno}",
+            message=(
+                f".{node.func.attr}() without a timeout in the serving "
+                "path: an unbounded blocking wait here can hang a "
+                "replica worker or the router itself forever — outside "
+                "what the heartbeat deadline can detect; pass "
+                "timeout= (graft-fleet failover contract)"
+            ),
+        ))
+    return [flagged[k] for k in sorted(flagged)]
+
+
 def lint_source(relpath: str, source: str) -> List[Finding]:
     """All AST findings for one package source file.
 
@@ -534,6 +584,7 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
         findings.extend(_ckpt_stamp_findings(tree, relpath, supp))
     if _in_scope(relpath, SERVE_SCOPE):
         findings.extend(_serve_dynamic_shape_findings(tree, relpath, supp))
+        findings.extend(_fleet_unbounded_wait_findings(tree, relpath, supp))
     return findings
 
 

@@ -32,7 +32,17 @@ Fault kinds:
   (the request id) for ``count`` sampled tokens starting at generated-
   token index ``step`` (exercises graft-serve's bad-request isolation:
   the request is evicted with an error status, co-resident requests are
-  untouched — serving/engine.py, scripts/chaos_sweep.py).
+  untouched — serving/engine.py, scripts/chaos_sweep.py);
+- ``kill-replica`` / ``stall-replica`` — fleet faults (graft-fleet): at
+  decode boundary ``step`` (1-based) of serving replica ``at``, the
+  replica worker dies abruptly (kill: in-flight requests lost, exactly a
+  SIGKILLed serving container) or stops making progress without dying
+  (stall: the hang class heartbeats exist for). The router must detect
+  either within its heartbeat deadline and replay the lost requests
+  elsewhere bit-identically (serving/fleet.py, serving/router.py);
+- ``flaky-channel`` — transient ``OSError`` on the next ``count``
+  dispatches to replica ``at`` (empty = any replica), exercising the
+  router's bounded dispatch retry (robustness/retry.py).
 """
 
 from __future__ import annotations
@@ -52,7 +62,7 @@ logger = get_logger(__name__)
 ENV_VAR = "DPX_CHAOS"
 KINDS = (
     "nan-batch", "inf-batch", "io-error", "kill", "rendezvous-flake",
-    "poison-request",
+    "poison-request", "kill-replica", "stall-replica", "flaky-channel",
 )
 
 
@@ -116,6 +126,18 @@ def preset(name: str) -> ChaosPlan:
     if name == "io-flake":
         # two transient write failures on `latest`; retry heals both
         return ChaosPlan([Fault("io-error", path_substr="latest", count=2)])
+    if name == "kill-replica":
+        # fleet replica r1 dies at its 8th decode boundary: late enough
+        # that requests are mid-stream, early enough that survivors still
+        # carry real load after the loss
+        return ChaosPlan([Fault("kill-replica", at="r1", step=8)])
+    if name == "stall-replica":
+        # same boundary, but the replica hangs instead of dying — only
+        # the heartbeat deadline can catch this one
+        return ChaosPlan([Fault("stall-replica", at="r1", step=8)])
+    if name == "flaky-channel":
+        # two transient dispatch failures; the router's bounded retry heals
+        return ChaosPlan([Fault("flaky-channel", count=2)])
     raise ValueError(f"unknown chaos preset {name!r}")
 
 
@@ -300,6 +322,57 @@ def poison_request(request_id: str, token_index: int) -> bool:
             )
             return True
     return False
+
+
+def replica_fault(replica_id: str, decode_step: int) -> Optional[str]:
+    """Fleet fault poll, called by each replica worker at its decode
+    boundaries (``decode_step`` is 1-based): ``"kill"`` — die abruptly,
+    losing in-flight state; ``"stall"`` — stop making progress without
+    dying; ``None`` — keep serving. Fires once per fault, at the first
+    boundary ``>= step`` (boundary counts differ run-to-run only under
+    preemption, so `>=` keeps the plan replayable)."""
+    plan = active()
+    if plan is None:
+        return None
+    for fault in plan.faults:
+        if (
+            fault.kind in ("kill-replica", "stall-replica")
+            and fault.at == str(replica_id)
+            and fault.fired == 0
+            and 0 <= fault.step <= decode_step
+        ):
+            fault.fired += 1
+            action = "kill" if fault.kind == "kill-replica" else "stall"
+            logger.warning(
+                "chaos: %s replica %r at decode boundary %d",
+                action, replica_id, decode_step,
+            )
+            return action
+    return None
+
+
+def flaky_channel(replica_id: str) -> None:
+    """Transient-``OSError`` injection on the router->replica dispatch
+    channel (top of the router's retried submit); ``at`` empty matches
+    any replica."""
+    plan = active()
+    if plan is None:
+        return
+    for fault in plan.faults:
+        if (
+            fault.kind == "flaky-channel"
+            and (not fault.at or fault.at == str(replica_id))
+            and fault.fired < fault.count
+        ):
+            fault.fired += 1
+            logger.warning(
+                "chaos: injected flaky channel to replica %r (%d/%d)",
+                replica_id, fault.fired, fault.count,
+            )
+            raise OSError(
+                errno.EIO,
+                f"chaos: injected flaky channel to replica {replica_id}",
+            )
 
 
 # ---------------------------------------------------------------------------

@@ -5,7 +5,8 @@ the training loop, mirroring the reference repo's inference entrypoint
 while staying TPU-native — two fixed compiled programs (bucketed prefill,
 fixed-slot decode), a host-side block allocator/scheduler, and pool
 shardings that match the training partitioner so TP checkpoints serve
-without gathering.
+without gathering. graft-fleet (fleet.py / router.py) scales this to N
+replicas behind a deterministic-failover router.
 """
 
 from distributed_pytorch_example_tpu.serving.cache import (
@@ -13,7 +14,18 @@ from distributed_pytorch_example_tpu.serving.cache import (
     BlockAllocator,
     PagedCacheConfig,
 )
-from distributed_pytorch_example_tpu.serving.engine import InferenceEngine
+from distributed_pytorch_example_tpu.serving.engine import (
+    EngineFetchTimeout,
+    InferenceEngine,
+)
+from distributed_pytorch_example_tpu.serving.fleet import (
+    ReplicaHandle,
+    ReplicaKilled,
+)
+from distributed_pytorch_example_tpu.serving.router import (
+    FleetRouter,
+    JournalEntry,
+)
 from distributed_pytorch_example_tpu.serving.sampling import (
     fold_keys,
     sample_rows,
@@ -28,8 +40,13 @@ from distributed_pytorch_example_tpu.serving.scheduler import (
 __all__ = [
     "SCRATCH_BLOCK",
     "BlockAllocator",
+    "EngineFetchTimeout",
+    "FleetRouter",
     "InferenceEngine",
+    "JournalEntry",
     "PagedCacheConfig",
+    "ReplicaHandle",
+    "ReplicaKilled",
     "Request",
     "RequestState",
     "Scheduler",

@@ -29,9 +29,10 @@ queue/prefill/decode trace spans land in the Chrome trace.
 
 from __future__ import annotations
 
+import concurrent.futures
 import time
 from functools import partial
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -56,7 +57,18 @@ from distributed_pytorch_example_tpu.serving.scheduler import (
     Scheduler,
 )
 
-__all__ = ["InferenceEngine", "Request"]
+__all__ = ["EngineFetchTimeout", "InferenceEngine", "Request"]
+
+
+class EngineFetchTimeout(RuntimeError):
+    """A device fetch exceeded the engine's ``fetch_timeout_s`` deadline.
+
+    Deliberately NOT retried by the fetch path (a hung transfer is a sick
+    accelerator or runtime, not a transient flake): it propagates out of
+    the serving loop so the fleet layer can report the replica unhealthy
+    and replay its requests elsewhere, instead of the decode loop hanging
+    forever inside ``jax.device_get``.
+    """
 
 
 def _constrain_paged_cache(cache, mesh, batch_axes: Tuple):
@@ -201,6 +213,7 @@ class InferenceEngine:
         clock=time.monotonic,
         sleep=time.sleep,
         mode: str = "continuous",
+        fetch_timeout_s: Optional[float] = None,
     ):
         nb = int(getattr(model, "paged_num_blocks", 0))
         bs = int(getattr(model, "paged_block_size", 0))
@@ -219,6 +232,10 @@ class InferenceEngine:
         self.clock = clock
         self.sleep = sleep
         self.mode = mode
+        self.fetch_timeout_s = fetch_timeout_s
+        self._fetch_pool: Optional[
+            concurrent.futures.ThreadPoolExecutor
+        ] = None
 
         self._mesh = None
         self._batch_axes: Tuple = ()
@@ -296,6 +313,34 @@ class InferenceEngine:
             f"prompt length {prompt_len} exceeds the largest prefill "
             f"bucket {self.prefill_buckets[-1]}"
         )
+
+    def _fetch(self, thunk: Callable, describe: str):
+        """Device fetch with graft-armor's transient retry AND (when
+        ``fetch_timeout_s`` is set) a per-attempt deadline: the thunk runs
+        on a dedicated fetch thread and ``EngineFetchTimeout`` is raised —
+        unretried — if it overruns, surfacing as a replica-health failure
+        rather than silently hanging the decode loop. A timed-out thunk's
+        thread stays blocked in the runtime; further fetches queue behind
+        it and time out too, which is correct — the replica is dead."""
+        if self.fetch_timeout_s is None:
+            return with_retries(thunk, describe=describe)
+
+        def bounded():
+            if self._fetch_pool is None:
+                self._fetch_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="dpx-serve-fetch"
+                )
+            fut = self._fetch_pool.submit(thunk)
+            try:
+                return fut.result(timeout=self.fetch_timeout_s)
+            except concurrent.futures.TimeoutError:
+                fut.cancel()
+                raise EngineFetchTimeout(
+                    f"{describe} exceeded the {self.fetch_timeout_s}s "
+                    "fetch deadline"
+                ) from None
+
+        return with_retries(bounded, describe=describe)
 
     def _ts_us(self) -> int:
         return int(self.clock() * 1e6)
@@ -396,9 +441,9 @@ class InferenceEngine:
                 jnp.int32(plen), jnp.asarray(poison),
                 **self._static_kw(),
             )
-            tok, ok = with_retries(
+            tok, ok = self._fetch(
                 lambda: jax.device_get((tok, ok)),
-                describe=f"serve prefill fetch ({req.rid})",
+                f"serve prefill fetch ({req.rid})",
             )
         self._cache = _merge_pages(self._cache, out_cache)
         self._span(f"prefill:{req.rid}", t0)
@@ -412,7 +457,9 @@ class InferenceEngine:
         self._slot_tokens[st.slot] = int(tok)
         return bool(ok)
 
-    def _run_decode(self, sched: Scheduler) -> None:
+    def _run_decode(self, sched: Scheduler) -> List[RequestState]:
+        """One fixed-slot decode step; returns the requests that finished
+        (done or evicted-with-error) at this boundary."""
         active = sched.active()
         ns = self.config.num_slots
         table = np.full(
@@ -439,13 +486,13 @@ class InferenceEngine:
                 jnp.asarray(positions), jnp.asarray(poison),
                 **self._static_kw(),
             )
-            nxt, ok = with_retries(
-                lambda: jax.device_get((nxt, ok)),
-                describe="serve decode fetch",
+            nxt, ok = self._fetch(
+                lambda: jax.device_get((nxt, ok)), "serve decode fetch"
             )
         self._cache = out_cache
         self._span("decode_step", t0)
         now = self.clock()
+        finished: List[RequestState] = []
         for slot, st in active:
             req = st.request
             if not bool(ok[slot]):
@@ -456,6 +503,7 @@ class InferenceEngine:
                           f"{len(st.generated)}",
                 )
                 self._span_request(st)
+                finished.append(st)
                 continue
             tok = int(nxt[slot])
             st.generated.append(tok)
@@ -467,6 +515,8 @@ class InferenceEngine:
             ):
                 sched.finish(st, "done", now=now)
                 self._span_request(st)
+                finished.append(st)
+        return finished
 
     def _span_request(self, st: RequestState) -> None:
         if self.trace is None:
@@ -481,6 +531,62 @@ class InferenceEngine:
         )
 
     # -- the serving loop -------------------------------------------------
+
+    def warmup(self) -> int:
+        """Compile-warm the serving programs: one tiny request per prefill
+        bucket, each decoding at least one token, served via ``run()`` —
+        so every bucket's prefill variant AND the decode step are in the
+        jit cache before real traffic. A fleet replica must be warmed
+        before joining a router whose heartbeat deadline is tighter than
+        XLA compile time (boundary beats freeze during compilation),
+        mirroring production pools that health-gate on a warmup probe.
+        The jit cache is shared, so warming one replica warms them all.
+        Returns the number of warmup requests served."""
+        bs = self.config.block_size
+        reqs = []
+        for i, bucket in enumerate(self.prefill_buckets):
+            plen = max(1, bucket - bs + 1)
+            max_new = 2 if plen + 2 <= self.config.max_context else 1
+            reqs.append(Request(
+                rid=f"_warmup{i}", prompt=[0] * plen,
+                max_new_tokens=max_new,
+            ))
+        self.run(reqs)
+        return len(reqs)
+
+    def _prefill_and_maybe_finish(
+        self, st: RequestState, sched: Scheduler,
+        on_finish: Optional[Callable] = None,
+    ) -> None:
+        """Prefill a newly admitted request and finish it immediately on
+        nonfinite logits, prompt-EOS, or a one-token budget."""
+        ok = self._run_prefill(st, sched.allocator)
+        req, tok = st.request, st.generated[-1]
+        if not ok:
+            sched.finish(
+                st, "error", now=self.clock(),
+                error="nonfinite logits at prefill",
+            )
+            self._span_request(st)
+            if on_finish is not None:
+                on_finish(st)
+        elif (
+            (req.eos_id is not None and tok == req.eos_id)
+            or req.max_new_tokens <= 1
+        ):
+            sched.finish(st, "done", now=self.clock())
+            self._span_request(st)
+            if on_finish is not None:
+                on_finish(st)
+
+    def _grow_or_preempt(self, sched: Scheduler) -> None:
+        """Grow each resident row's table at a decode boundary, preempting
+        the youngest resident until the growth fits."""
+        for _slot, st in list(sched.active()):
+            while st.status == "running" and not sched.grow(st):
+                victim = sched.preempt_youngest()
+                if victim is None or victim is st:
+                    break
 
     def run(self, requests: Sequence[Request], *,
             mode: Optional[str] = None) -> dict:
@@ -504,20 +610,7 @@ class InferenceEngine:
                 states[req.rid] = sched.submit(req, now)
                 next_arrival += 1
             for st in sched.admit(now):
-                ok = self._run_prefill(st, sched.allocator)
-                req, tok = st.request, st.generated[-1]
-                if not ok:
-                    sched.finish(
-                        st, "error", now=self.clock(),
-                        error="nonfinite logits at prefill",
-                    )
-                    self._span_request(st)
-                elif (
-                    (req.eos_id is not None and tok == req.eos_id)
-                    or req.max_new_tokens <= 1
-                ):
-                    sched.finish(st, "done", now=self.clock())
-                    self._span_request(st)
+                self._prefill_and_maybe_finish(st, sched)
 
             active = sched.active()
             if not active:
@@ -541,11 +634,7 @@ class InferenceEngine:
 
             # decode boundary: grow each resident row's table; preempt the
             # youngest resident until the growth fits
-            for slot, st in list(active):
-                while st.status == "running" and not sched.grow(st):
-                    victim = sched.preempt_youngest()
-                    if victim is None or victim is st:
-                        break
+            self._grow_or_preempt(sched)
             active = sched.active()
             if not active:
                 continue
@@ -557,6 +646,80 @@ class InferenceEngine:
         return self._report(
             states, sched, elapsed, decode_steps, occupied_rows
         )
+
+    def serve_loop(
+        self,
+        *,
+        poll: Callable[[float], Optional[Request]],
+        should_stop: Callable[[], bool],
+        on_finish: Callable[[RequestState], None],
+        on_tick: Optional[Callable] = None,
+        idle_wait: float = 0.02,
+    ) -> Scheduler:
+        """Incremental serving loop — the fleet-replica entry point.
+
+        Unlike ``run()`` (a closed workload served to completion), this
+        pulls work as it arrives and keeps serving until drained AND told
+        to stop — the drain hook a router needs to retire a replica
+        without dropping in-flight requests:
+
+        - ``poll(timeout_s)`` returns the next dispatched :class:`Request`
+          or ``None`` (the replica's inbox; every wait is bounded);
+        - ``should_stop()`` is consulted only when idle, so a drain
+          request finishes every resident/queued request first;
+        - ``on_finish(state)`` fires per finished request (done, error,
+          or rejected at submit);
+        - ``on_tick(sched, step_idx, rows)`` fires at every boundary —
+          ``rows`` > 0 after a decode step of that many occupied rows,
+          0 on an idle poll. This is the fleet's heartbeat, in-flight
+          journal snapshot, and chaos injection point; ``step_idx`` is
+          the 1-based decode-boundary counter.
+
+        Returns the scheduler (final counters) on clean drain. A raised
+        exception (chaos kill, :class:`EngineFetchTimeout`) abandons the
+        scheduler state — exactly a dead serving process.
+        """
+        sched = Scheduler(self.config, mode=self.mode)
+        step_idx = 0
+
+        def _submit(req: Request) -> None:
+            st = sched.submit(req, self.clock())
+            if st.status == "rejected":
+                on_finish(st)
+
+        while True:
+            req = poll(0.0)
+            while req is not None:
+                _submit(req)
+                req = poll(0.0)
+            for st in sched.admit(self.clock()):
+                self._prefill_and_maybe_finish(st, sched, on_finish)
+
+            if not sched.active():
+                if sched.queue:
+                    raise RuntimeError(
+                        "scheduler deadlock: queued requests but no "
+                        "admissible slot on an empty batch"
+                    )
+                if should_stop():
+                    return sched
+                if on_tick is not None:
+                    on_tick(sched, step_idx, 0)
+                req = poll(idle_wait)
+                if req is not None:
+                    _submit(req)
+                continue
+
+            self._grow_or_preempt(sched)
+            rows = len(sched.active())
+            if not rows:
+                continue
+            finished = self._run_decode(sched)
+            step_idx += 1
+            for st in finished:
+                on_finish(st)
+            if on_tick is not None:
+                on_tick(sched, step_idx, rows)
 
     def _report(self, states, sched, elapsed, decode_steps, occupied_rows):
         results = {}

@@ -1,0 +1,301 @@
+"""graft-fleet replica handles: one serving replica per worker thread.
+
+A :class:`ReplicaHandle` wraps one :class:`InferenceEngine` in the
+process-shaped box a fleet needs: a bounded-wait inbox the router
+dispatches into, a worker thread driving ``engine.serve_loop``, a
+heartbeat + in-flight snapshot the router polls from outside, and
+drain/abort controls. On this box replicas are threads over the fake CPU
+mesh; the handle surface (``submit`` / ``snapshot`` / ``last_beat`` /
+``request_drain`` / ``abort`` / ``drain_outstanding``) is deliberately
+process-agnostic — it is the seam where a real multi-host deployment
+substitutes an RPC stub per serving container, mirroring how the
+reference example fronts one container per rank behind a hostname
+rendezvous (reference train.py:21-36, entrypoint.sh).
+
+Failure model (mirrors graft-armor's named-site injection):
+
+- **kill** (``kill-replica`` chaos fault, or any exception out of the
+  serving loop, including :class:`EngineFetchTimeout` from a hung device
+  fetch): the thread dies abruptly; in-flight scheduler state is LOST,
+  exactly like a SIGKILLed container. Recovery data lives only in what
+  was streamed out before death — the per-boundary snapshot the router
+  journals.
+- **stall** (``stall-replica``): the thread stops making progress
+  without dying; the heartbeat timestamp freezes and only the router's
+  deadline can detect it. The stalled thread parks on an abort event so
+  the router can reclaim it deterministically after detection.
+
+Every blocking wait here carries a timeout — enforced by the
+``fleet-unbounded-wait`` graft-lint rule over ``serving/``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from distributed_pytorch_example_tpu.robustness import chaos
+from distributed_pytorch_example_tpu.serving.engine import InferenceEngine
+from distributed_pytorch_example_tpu.serving.scheduler import (
+    Request,
+    RequestState,
+)
+
+__all__ = ["ReplicaKilled", "ReplicaHandle"]
+
+
+class ReplicaKilled(BaseException):
+    """Tears a replica worker out of its serving loop (chaos kill, or a
+    router abort of a stalled worker). Derives from ``BaseException`` so
+    no engine-level ``except Exception`` can accidentally swallow the
+    death — only the worker's own top-level handler catches it."""
+
+
+class ReplicaHandle:
+    """One fleet replica: an engine, its worker thread, and the
+    outside-view state the router reads.
+
+    The worker owns the engine and its scheduler exclusively; the router
+    thread only touches the inbox, the lock-guarded snapshot fields, and
+    the drain/abort events. ``on_finish`` (wired by the router) receives
+    a plain result dict per finished request — the replica's outbound
+    stream.
+    """
+
+    def __init__(
+        self,
+        replica_id: str,
+        engine: InferenceEngine,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        idle_wait: float = 0.02,
+    ):
+        self.replica_id = str(replica_id)
+        self.engine = engine
+        self.clock = clock
+        self.idle_wait = idle_wait
+        self.on_finish: Optional[Callable[[dict], None]] = None
+
+        self._inbox: "queue.Queue[Request]" = queue.Queue()
+        self._drain = threading.Event()
+        self._abort = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+        # router-visible snapshot (guarded by _lock)
+        self._state = "new"  # new|live|stopped|dead
+        self._error = ""
+        self._last_beat = self.clock()
+        self._inflight: Dict[str, List[int]] = {}
+        self._free_slots = engine.config.num_slots
+        self._free_blocks = engine.config.num_blocks - 1  # minus scratch
+        self._prev_decode_t: Optional[float] = None
+        self._step_samples: List[Tuple[float, float]] = []  # (t, s/row)
+        self.decode_steps = 0
+        self.occupied_rows = 0
+        self.finished = 0
+
+    # -- router-facing surface (any thread) -------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._work, name=f"dpx-replica-{self.replica_id}",
+            daemon=True,  # a chaos-stalled worker must not block exit
+        )
+        with self._lock:
+            self._state = "live"
+            self._last_beat = self.clock()
+        self._thread.start()
+
+    def submit(self, request: Request) -> None:
+        """Dispatch one request into the replica's inbox (the channel a
+        real deployment replaces with an RPC; ``flaky-channel`` chaos is
+        injected by the router around this call)."""
+        self._inbox.put(request)
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def error(self) -> str:
+        with self._lock:
+            return self._error
+
+    def last_beat(self) -> float:
+        with self._lock:
+            return self._last_beat
+
+    def alive(self) -> bool:
+        """Live AND the worker thread is actually running — a dead thread
+        with a fresh heartbeat is still a dead replica."""
+        with self._lock:
+            if self._state != "live":
+                return False
+        return self._thread is not None and self._thread.is_alive()
+
+    def snapshot(self) -> dict:
+        """The admission/journal view: free capacity straight from the
+        scheduler's free-block accounting (as of the last boundary),
+        inbox depth, and tokens-so-far per in-flight request — the
+        'streamed to the journal' state that survives a kill."""
+        with self._lock:
+            return {
+                "state": self._state,
+                "free_slots": self._free_slots,
+                "free_blocks": self._free_blocks,
+                "inbox_depth": self._inbox.qsize(),
+                "resident": len(self._inflight),
+                "inflight": {
+                    rid: list(toks) for rid, toks in self._inflight.items()
+                },
+            }
+
+    def request_drain(self) -> None:
+        """Graceful retirement: the worker finishes every resident and
+        queued request, then exits its serving loop."""
+        self._drain.set()
+
+    def abort(self) -> None:
+        """Hard reclaim of a lost replica: unparks a stalled worker (which
+        then dies via :class:`ReplicaKilled`) and marks the handle dead so
+        no further work routes here."""
+        self._abort.set()
+        with self._lock:
+            if self._state == "live":
+                self._state = "dead"
+                self._error = self._error or "aborted by router"
+
+    def drain_outstanding(self) -> Tuple[List[Request], Dict[str, List[int]]]:
+        """After ``abort()``: everything the dead replica still owed —
+        inbox requests never admitted, and the last journal snapshot of
+        in-flight requests (rid -> tokens emitted so far)."""
+        undispatched: List[Request] = []
+        while True:
+            try:
+                undispatched.append(self._inbox.get_nowait())
+            except queue.Empty:
+                break
+        with self._lock:
+            inflight = {r: list(t) for r, t in self._inflight.items()}
+            self._inflight = {}
+        return undispatched, inflight
+
+    def join(self, timeout: float = 5.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def step_samples(self) -> List[Tuple[float, float]]:
+        """(timestamp, seconds-per-occupied-row) per consecutive
+        FULL-occupancy decode boundary — the steady-state cost samples
+        the router's ``steady_per_row_ms`` metric is computed from."""
+        with self._lock:
+            return list(self._step_samples)
+
+    def occupancy(self) -> float:
+        with self._lock:
+            steps = self.decode_steps
+            rows = self.occupied_rows
+        slots = self.engine.config.num_slots
+        return rows / (steps * slots) if steps else 0.0
+
+    # -- worker side -------------------------------------------------------
+
+    def _work(self) -> None:
+        try:
+            self.engine.serve_loop(
+                poll=self._poll,
+                should_stop=self._should_stop,
+                on_finish=self._report,
+                on_tick=self._tick,
+                idle_wait=self.idle_wait,
+            )
+            with self._lock:
+                self._state = "stopped"
+        except ReplicaKilled as death:
+            with self._lock:
+                self._state = "dead"
+                self._error = self._error or str(death) or "killed"
+        except BaseException as err:  # noqa: BLE001 — a dead worker must
+            # never take the process down; it surfaces as replica health
+            with self._lock:
+                self._state = "dead"
+                self._error = f"{type(err).__name__}: {err}"
+
+    def _poll(self, timeout: float) -> Optional[Request]:
+        if self._abort.is_set():
+            raise ReplicaKilled("aborted by router")
+        with self._lock:
+            self._last_beat = self.clock()
+        try:
+            if timeout <= 0:
+                return self._inbox.get_nowait()
+            return self._inbox.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _should_stop(self) -> bool:
+        return self._drain.is_set() and self._inbox.qsize() == 0
+
+    def _report(self, st: RequestState) -> None:
+        now = self.clock()
+        with self._lock:
+            self._inflight.pop(st.request.rid, None)
+            self.finished += 1
+        if self.on_finish is not None:
+            self.on_finish({
+                "replica": self.replica_id,
+                "rid": st.request.rid,
+                "status": st.status,
+                "tokens": list(st.generated),
+                "error": st.error,
+                "prompt_len": st.prompt_len,
+                "preemptions": st.preemptions,
+                "ttft_s": (
+                    st.t_first - st.t_admit if st.t_first else None
+                ),
+                "t_done": now,
+            })
+
+    def _tick(self, sched, step_idx: int, rows: int) -> None:
+        now = self.clock()
+        with self._lock:
+            self._last_beat = now
+            self._inflight = {
+                st.request.rid: list(st.generated)
+                for _slot, st in sched.active()
+            }
+            self._free_slots = sched.free_slots()
+            self._free_blocks = sched.allocator.free_count()
+            if rows:
+                self.decode_steps += 1
+                self.occupied_rows += rows
+                # sample only full-occupancy boundaries: per-row cost
+                # shrinks as rows grow (fixed step overhead amortizes),
+                # so mixing occupancies makes runs incomparable — the
+                # ramp-up profile would dominate the steady-state stat
+                if (
+                    self._prev_decode_t is not None
+                    and rows == self.engine.config.num_slots
+                ):
+                    self._step_samples.append(
+                        (now, (now - self._prev_decode_t) / rows)
+                    )
+                self._prev_decode_t = now
+            else:
+                self._prev_decode_t = None
+        if rows:
+            action = chaos.replica_fault(self.replica_id, step_idx)
+            if action == "kill":
+                raise ReplicaKilled("chaos kill-replica")
+            if action == "stall":
+                self._stall()
+
+    def _stall(self) -> None:
+        # frozen mid-decode: no heartbeats, no progress, thread alive —
+        # parked in bounded waits until the router's deadline fires and
+        # abort() reclaims the worker
+        while not self._abort.wait(0.05):
+            pass
+        raise ReplicaKilled("chaos stall-replica (reclaimed after detection)")

@@ -1,0 +1,455 @@
+"""graft-fleet router: deterministic failover across serving replicas.
+
+The :class:`FleetRouter` fronts N :class:`ReplicaHandle` replicas with
+the four production planes the single-replica engine lacks:
+
+- **admission** — a request is dispatched only when a replica's last
+  boundary snapshot (free decode slots + the scheduler's free-block
+  count, ``serving/cache.BlockAllocator``) covers its prompt; placement
+  is session-affine first (one session sticks to one replica, so its KV
+  reuse and ordering stay local), least-loaded otherwise, FIFO
+  head-of-line overall — the same determinism stance as the scheduler;
+- **health** — a heartbeat deadline over the replicas' boundary beats,
+  the same detect-then-rebuild shape as graft-elastic's survivor probe
+  (``runtime/distributed.shrink_to_survivors``): a dead worker thread is
+  caught immediately, a stalled one when its beat goes stale; either way
+  the replica is reclaimed and its requests move;
+- **the request journal** — per request: prompt, seed, sampling params
+  (engine-level), and the tokens streamed out at every decode boundary.
+  Replay = redispatch from the prompt; per-request position-folded rng
+  (``serving/sampling.fold_keys``) makes the replayed stream bit-
+  identical, so the journaled prefix is verified token-exact on every
+  replayed completion (``replay_token_exact``);
+- **degradation** — a bounded router queue (overflow and deadline
+  shedding) and per-dispatch retry with deterministic backoff
+  (``robustness/retry.with_retries``) against ``flaky-channel`` chaos,
+  so failures shed load instead of piling it up.
+
+Single-threaded control loop: the router owns journal/queue/affinity
+state exclusively; replica workers communicate inward only through the
+thread-safe completion queue. Every blocking wait is deadline-bounded
+(graft-lint ``fleet-unbounded-wait``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import statistics
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence
+
+from distributed_pytorch_example_tpu.robustness import chaos
+from distributed_pytorch_example_tpu.robustness.retry import with_retries
+from distributed_pytorch_example_tpu.serving.fleet import ReplicaHandle
+from distributed_pytorch_example_tpu.serving.scheduler import Request
+
+__all__ = ["FleetRouter", "JournalEntry"]
+
+_TERMINAL = ("done", "error", "rejected", "shed")
+
+
+@dataclasses.dataclass
+class JournalEntry:
+    """Everything needed to replay one request bit-identically, plus its
+    routing history. ``tokens`` is the journal's streamed view — the
+    tokens the assigned replica had emitted as of its last boundary —
+    NOT the final output (that arrives in ``result``)."""
+
+    request: Request
+    status: str = "queued"  # queued|dispatched|done|error|rejected|shed
+    replica: str = ""
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    result: Optional[dict] = None
+    error: str = ""
+    dispatches: int = 0
+    replays: int = 0  # redispatches that had already emitted tokens
+    replay_token_exact: Optional[bool] = None
+    t_submit: float = 0.0
+    t_dispatch: float = 0.0
+    t_done: float = 0.0
+
+
+class FleetRouter:
+    """Elastic multi-replica serving router (see module docstring)."""
+
+    def __init__(
+        self,
+        replicas: Sequence[ReplicaHandle],
+        *,
+        clock=time.monotonic,
+        sleep=time.sleep,
+        heartbeat_timeout_s: float = 5.0,
+        max_queue: int = 64,
+        queue_deadline_s: float = 30.0,
+        dispatch_attempts: int = 4,
+        dispatch_base_delay: float = 0.01,
+        trace=None,
+    ):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        ids = [h.replica_id for h in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        self.replicas = list(replicas)
+        self.clock = clock
+        self.sleep = sleep
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.max_queue = int(max_queue)
+        self.queue_deadline_s = float(queue_deadline_s)
+        self.dispatch_attempts = int(dispatch_attempts)
+        self.dispatch_base_delay = float(dispatch_base_delay)
+        self.trace = trace
+
+        self._completions: "queue.Queue[dict]" = queue.Queue()
+        self._affinity: Dict[str, str] = {}  # session -> replica_id
+        self._lost: Dict[str, float] = {}  # replica_id -> detection latency
+        self._t_first_loss: Optional[float] = None
+        self.counters: Dict[str, int] = {
+            "shed": 0, "redispatched": 0, "replayed": 0,
+            "dispatch_retries": 0, "stale_results": 0,
+        }
+        self._queue_depth_max = 0
+
+    # -- placement ---------------------------------------------------------
+
+    def _live(self) -> List[ReplicaHandle]:
+        return [
+            h for h in self.replicas
+            if h.replica_id not in self._lost and h.alive()
+        ]
+
+    @staticmethod
+    def _admissible(handle: ReplicaHandle, snap: dict, req: Request) -> bool:
+        """Conservative capacity check from the replica's last boundary
+        snapshot: a free slot beyond what is already inbox-queued, and
+        free blocks covering prompt+1 for this request AND every queued
+        one (each queued request needs at least that much again)."""
+        need = handle.engine.config.blocks_for(len(req.prompt) + 1)
+        backlog = snap["inbox_depth"]
+        return (
+            snap["free_slots"] - backlog > 0
+            and snap["free_blocks"] >= need * (backlog + 1)
+        )
+
+    def _place(self, entry: JournalEntry) -> Optional[ReplicaHandle]:
+        live = self._live()
+        session = entry.request.session
+        if session is not None:
+            sticky = self._affinity.get(session)
+            if sticky is not None:
+                handle = next(
+                    (h for h in live if h.replica_id == sticky), None
+                )
+                if handle is None:
+                    del self._affinity[session]  # rehome: replica lost
+                elif self._admissible(handle, handle.snapshot(), entry.request):
+                    return handle
+                else:
+                    return None  # sticky but full: wait (stay affine)
+        best, best_key = None, None
+        for handle in live:
+            snap = handle.snapshot()
+            if not self._admissible(handle, snap, entry.request):
+                continue
+            # least-loaded: most open slots, then most free blocks;
+            # replica order breaks ties deterministically
+            key = (
+                snap["free_slots"] - snap["inbox_depth"],
+                snap["free_blocks"],
+            )
+            if best_key is None or key > best_key:
+                best, best_key = handle, key
+        return best
+
+    def _dispatch(self, entry: JournalEntry, handle: ReplicaHandle,
+                  now: float) -> None:
+        req = entry.request
+
+        def send():
+            chaos.flaky_channel(handle.replica_id)
+            handle.submit(req)
+
+        def count_retry(_attempt, _err):
+            self.counters["dispatch_retries"] += 1
+
+        entry.status = "dispatched"
+        entry.replica = handle.replica_id
+        entry.dispatches += 1
+        entry.t_dispatch = now
+        if req.session is not None:
+            self._affinity[req.session] = handle.replica_id
+        if self.trace is not None:
+            self.trace.add_complete(
+                f"router/queue:{req.rid}",
+                int(entry.t_submit * 1e6),
+                int((now - entry.t_submit) * 1e6),
+            )
+        try:
+            with_retries(
+                send,
+                attempts=self.dispatch_attempts,
+                base_delay=self.dispatch_base_delay,
+                describe=f"dispatch {req.rid} -> {handle.replica_id}",
+                sleep=self.sleep,
+                on_retry=count_retry,
+            )
+        except OSError as err:
+            entry.status = "error"
+            entry.error = f"dispatch failed: {err}"
+            entry.t_done = now
+
+    # -- failure handling --------------------------------------------------
+
+    def _check_health(self, journal: Dict[str, JournalEntry],
+                      order: List[str], rqueue: Deque[JournalEntry],
+                      now: float) -> None:
+        for handle in self.replicas:
+            rep = handle.replica_id
+            if rep in self._lost or handle.state() == "stopped":
+                continue
+            beat = handle.last_beat()
+            if handle.alive() and now - beat <= self.heartbeat_timeout_s:
+                continue
+            # lost: dead worker (immediate) or stale heartbeat (deadline)
+            self._lost[rep] = now - beat
+            if self._t_first_loss is None:
+                self._t_first_loss = now
+            handle.abort()
+            _undispatched, inflight = handle.drain_outstanding()
+            self._affinity = {
+                s: r for s, r in self._affinity.items() if r != rep
+            }
+            moved = [
+                journal[rid] for rid in order
+                if journal[rid].status == "dispatched"
+                and journal[rid].replica == rep
+            ]
+            for entry in moved:
+                snapshot = inflight.get(entry.request.rid)
+                if snapshot:
+                    entry.tokens = list(snapshot)
+                if entry.tokens:
+                    entry.replays += 1
+                    self.counters["replayed"] += 1
+                entry.status = "queued"
+                entry.replica = ""
+                self.counters["redispatched"] += 1
+            # front-requeue in original FIFO order: the lost replica's
+            # requests keep their seniority, like preempt_youngest
+            rqueue.extendleft(reversed(moved))
+            if self.trace is not None:
+                self.trace.add_complete(
+                    f"router/replica_lost:{rep}", int(beat * 1e6),
+                    int((now - beat) * 1e6),
+                )
+
+    def _shed(self, entry: JournalEntry, now: float, why: str) -> None:
+        entry.status = "shed"
+        entry.error = why
+        entry.t_done = now
+        self.counters["shed"] += 1
+        if self.trace is not None:
+            self.trace.add_complete(
+                f"router/shed:{entry.request.rid}",
+                int(entry.t_submit * 1e6),
+                int((now - entry.t_submit) * 1e6),
+            )
+
+    def _drain_completions(self, journal: Dict[str, JournalEntry]) -> None:
+        while True:
+            try:
+                res = self._completions.get_nowait()
+            except queue.Empty:
+                return
+            entry = journal.get(res["rid"])
+            if (
+                entry is None
+                or entry.status != "dispatched"
+                or entry.replica != res["replica"]
+            ):
+                # late result from a replica we already failed over from
+                self.counters["stale_results"] += 1
+                continue
+            entry.status = res["status"]
+            entry.error = res.get("error", "")
+            entry.result = res
+            entry.t_done = res.get("t_done", self.clock())
+            if entry.replays and entry.status == "done":
+                entry.replay_token_exact = (
+                    res["tokens"][: len(entry.tokens)] == entry.tokens
+                )
+
+    # -- the routing loop --------------------------------------------------
+
+    def run(self, requests: Sequence[Request], *,
+            timeout_s: float = 600.0) -> dict:
+        """Route an open-loop workload to completion across the fleet;
+        returns per-request results plus router/fleet metrics."""
+        for handle in self.replicas:
+            handle.on_finish = self._completions.put
+            if handle.state() == "new":
+                handle.start()
+        pending = sorted(requests, key=lambda r: (r.arrival, r.rid))
+        journal: Dict[str, JournalEntry] = {}
+        order: List[str] = []
+        rqueue: Deque[JournalEntry] = deque()
+        next_arrival = 0
+        t_start = self.clock()
+
+        try:
+            while True:
+                now = self.clock()
+                if now - t_start > timeout_s:
+                    stuck = [
+                        rid for rid in order
+                        if journal[rid].status not in _TERMINAL
+                    ]
+                    raise RuntimeError(
+                        f"router wall deadline ({timeout_s}s) exceeded "
+                        f"with unfinished requests: {stuck}"
+                    )
+                while (
+                    next_arrival < len(pending)
+                    and pending[next_arrival].arrival <= now
+                ):
+                    req = pending[next_arrival]
+                    next_arrival += 1
+                    entry = JournalEntry(request=req, t_submit=now)
+                    journal[req.rid] = entry
+                    order.append(req.rid)
+                    if len(rqueue) >= self.max_queue:
+                        self._shed(entry, now, "router queue full")
+                    else:
+                        rqueue.append(entry)
+                self._queue_depth_max = max(
+                    self._queue_depth_max, len(rqueue)
+                )
+
+                # completions BEFORE health: a finished request must never
+                # be replayed because its replica died a tick later
+                self._drain_completions(journal)
+                self._check_health(journal, order, rqueue, now)
+
+                # deadline shedding, oldest first
+                while rqueue and (
+                    now - rqueue[0].t_submit > self.queue_deadline_s
+                ):
+                    self._shed(
+                        rqueue.popleft(), now,
+                        f"queued past deadline {self.queue_deadline_s}s",
+                    )
+
+                while rqueue:
+                    handle = self._place(rqueue[0])
+                    if handle is None:
+                        break  # head-of-line, like Scheduler.admit
+                    self._dispatch(rqueue.popleft(), handle, now)
+
+                if next_arrival >= len(pending) and all(
+                    journal[rid].status in _TERMINAL for rid in order
+                ):
+                    break
+                if not self._live() and rqueue:
+                    stuck = [e.request.rid for e in rqueue]
+                    raise RuntimeError(
+                        f"all replicas lost with requests queued: {stuck}"
+                    )
+                self.sleep(0.002)
+        finally:
+            for handle in self.replicas:
+                handle.request_drain()
+            for handle in self.replicas:
+                handle.join(timeout=10.0)
+                if handle.alive():
+                    handle.abort()
+
+        elapsed = max(self.clock() - t_start, 1e-9)
+        return self._report(journal, order, elapsed)
+
+    # -- reporting ---------------------------------------------------------
+
+    def _report(self, journal: Dict[str, JournalEntry], order: List[str],
+                elapsed: float) -> dict:
+        results = {}
+        generated = 0
+        status_counts = {s: 0 for s in _TERMINAL}
+        replay_checks: List[bool] = []
+        for rid in sorted(order):
+            entry = journal[rid]
+            res = entry.result or {}
+            tokens = res.get("tokens", [])
+            results[rid] = {
+                "status": entry.status,
+                "tokens": list(tokens),
+                "error": entry.error or res.get("error", ""),
+                "replica": entry.replica,
+                "dispatches": entry.dispatches,
+                "replays": entry.replays,
+                "replay_token_exact": entry.replay_token_exact,
+                "preemptions": res.get("preemptions", 0),
+            }
+            status_counts[entry.status] = (
+                status_counts.get(entry.status, 0) + 1
+            )
+            if entry.status in ("done", "error"):
+                generated += len(tokens)
+            if entry.replay_token_exact is not None:
+                replay_checks.append(entry.replay_token_exact)
+
+        # steady state = every replica at full strength (before the first
+        # loss); the per-row boundary cost there measures what the fleet
+        # machinery adds, not the capacity the fault removed
+        cutoff = self._t_first_loss
+        stamped = sorted(
+            (t, per_row)
+            for handle in self.replicas
+            for (t, per_row) in handle.step_samples()
+            if cutoff is None or t < cutoff
+        )
+        samples = [per_row for (_t, per_row) in stamped]
+        per_replica = {}
+        for handle in self.replicas:
+            per_replica[handle.replica_id] = {
+                "state": handle.state(),
+                "occupancy": handle.occupancy(),
+                "decode_steps": handle.decode_steps,
+                "finished": handle.finished,
+                "error": handle.error(),
+            }
+        metrics = {
+            "replicas": len(self.replicas),
+            "completed": status_counts["done"],
+            "errored": status_counts["error"],
+            "rejected": status_counts["rejected"],
+            **self.counters,
+            "replicas_lost": len(self._lost),
+            "detection_latency_s": (
+                max(self._lost.values()) if self._lost else None
+            ),
+            "replay_token_exact": (
+                all(replay_checks) if replay_checks else None
+            ),
+            "queue_depth_max": self._queue_depth_max,
+            "elapsed_s": elapsed,
+            "generated_tokens": generated,
+            "tokens_per_sec": generated / elapsed,
+            "steady_per_row_ms": (
+                statistics.median(samples) * 1e3 if samples else None
+            ),
+            # the min is the noise-robust overhead statistic: host
+            # scheduling jitter only ever ADDS time, so best-boundary
+            # cost moves only when the machinery itself gets slower
+            "steady_per_row_ms_min": (
+                min(samples) * 1e3 if samples else None
+            ),
+            # time-ordered pre-loss samples, for consumers that compare
+            # two runs over equal-length windows (e.g. serve.py's
+            # steady_state_ratio truncates the clean run's stream to the
+            # chaos run's pre-loss window so both sides are equally
+            # contended); stripped from emitted JSON lines
+            "steady_samples_ms": [s * 1e3 for s in samples],
+            "per_replica": per_replica,
+        }
+        return {"results": results, "metrics": metrics}

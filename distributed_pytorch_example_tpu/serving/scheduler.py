@@ -47,6 +47,7 @@ class Request:
     seed: int = 0
     eos_id: Optional[int] = None
     arrival: float = 0.0  # open-loop submit time (load-generator clock)
+    session: Optional[str] = None  # fleet router: session-affinity key
 
 
 @dataclasses.dataclass
@@ -138,6 +139,11 @@ class Scheduler:
 
     def has_work(self) -> bool:
         return bool(self.queue) or any(s is not None for s in self.slots)
+
+    def free_slots(self) -> int:
+        """Open decode slots — one half of the admission capacity the
+        fleet router reads (the other is ``allocator.free_count()``)."""
+        return sum(1 for s in self.slots if s is None)
 
     # -- decode-boundary operations --------------------------------------
 

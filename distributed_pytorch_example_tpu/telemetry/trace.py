@@ -96,3 +96,25 @@ class TraceWriter:
             self._fh.write("\n]\n")
             self._fh.close()
             self._fh = None
+
+
+class PrefixedTrace:
+    """A named view of one :class:`TraceWriter` — every span lands as
+    ``"<prefix>/<name>"`` in the shared trace file.
+
+    graft-fleet hands each replica's engine one of these (prefix =
+    replica id), so a 2-replica run produces ``r0/decode_step`` and
+    ``r1/decode_step`` spans in ONE Chrome trace; the replicas' worker
+    threads already map to distinct ``tid`` tracks via the base writer.
+    Exposes the subset of the writer API the serving engine uses.
+    """
+
+    def __init__(self, base: TraceWriter, prefix: str):
+        self._base = base
+        self._prefix = prefix
+
+    def add_complete(self, name: str, ts_us: int, dur_us: int) -> None:
+        self._base.add_complete(f"{self._prefix}/{name}", ts_us, dur_us)
+
+    def span(self, name: str):
+        return self._base.span(f"{self._prefix}/{name}")

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Pre-commit fast path: the backend-free graft-lint rule set (<5s).
+#
+# Runs every AST lint fixture plus the shipped-clean gates (the real
+# serving/train modules must carry zero findings) without initializing a
+# JAX backend, so it is safe on any box — laptop, CI, or the TPU host.
+#
+#   ./scripts/precommit.sh
+#
+# Wire it up with: ln -s ../../scripts/precommit.sh .git/hooks/pre-commit
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_graft_lint.py \
+    -m lint -q -p no:cacheprovider

@@ -541,7 +541,7 @@ def test_chaos_sweep_fast_subset_green():
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert [r["scenario"] for r in lines] == [
         "nan-skip", "corrupt-latest", "io-flake", "rendezvous-flake",
-        "kill-slice", "poison-request",
+        "kill-slice", "poison-request", "kill-replica-midstream",
     ]
     assert all(r["ok"] for r in lines), lines
     by_name = {r["scenario"]: r for r in lines}
@@ -551,6 +551,11 @@ def test_chaos_sweep_fast_subset_green():
     poison = by_name["poison-request"]
     assert poison["action"] == "evict-poisoned-request"
     assert poison["co_resident_bit_identical"] is True
+    fleet = by_name["kill-replica-midstream"]
+    assert fleet["action"] == "failover-replay"
+    assert fleet["greedy"]["bit_identical_to_clean"] is True
+    assert fleet["seeded-topk"]["replay_token_exact"] is True
+    assert fleet["steady_state_ratio"] <= 1.05
 
 
 @pytest.mark.slow

@@ -328,6 +328,63 @@ def test_serve_real_engine_module_lints_clean():
 
 
 @pytest.mark.lint
+def test_fleet_unbounded_wait_fires_on_bare_waits():
+    src = (
+        "def pump(inbox, done, worker):\n"
+        "    req = inbox.get()\n"
+        "    done.wait()\n"
+        "    worker.join()\n"
+        "    return req\n"
+    )
+    findings = pylint_rules.lint_source("serving/fleet.py", src)
+    assert _rules(findings) == ["fleet-unbounded-wait"] * 3
+    assert "fleet.py:2" in findings[0].where
+
+
+@pytest.mark.lint
+def test_fleet_unbounded_wait_quiet_on_bounded_and_lookalikes():
+    # timeout kwarg, non-blocking get, dict.get, str.join: all fine
+    src = (
+        "def pump(inbox, done, worker, table, parts):\n"
+        "    a = inbox.get(timeout=1.0)\n"
+        "    b = inbox.get(block=False)\n"
+        "    done.wait(0.05)\n"
+        "    worker.join(timeout=5.0)\n"
+        "    c = table.get('key')\n"
+        "    d = ','.join(parts)\n"
+        "    return a, b, c, d\n"
+    )
+    assert pylint_rules.lint_source("serving/router.py", src) == []
+
+
+@pytest.mark.lint
+def test_fleet_unbounded_wait_scope_and_suppression():
+    src = (
+        "def pump(inbox):\n"
+        "    return inbox.get()\n"
+    )
+    # only serving/ is in scope: a training-side queue may block forever
+    assert pylint_rules.lint_source("train/loop.py", src) == []
+    supp = src.replace(
+        "inbox.get()", "inbox.get()  # graft-lint: fleet-unbounded-wait"
+    )
+    assert pylint_rules.lint_source("serving/fleet.py", supp) == []
+
+
+@pytest.mark.lint
+def test_fleet_real_modules_lint_clean():
+    # the acceptance gate: the shipped fleet/router layers carry a
+    # timeout on every blocking wait, as committed
+    for mod in ("fleet.py", "router.py", "engine.py"):
+        path = os.path.join(
+            REPO_ROOT, "distributed_pytorch_example_tpu", "serving", mod,
+        )
+        with open(path) as fh:
+            src = fh.read()
+        assert pylint_rules.lint_source(f"serving/{mod}", src) == [], mod
+
+
+@pytest.mark.lint
 def test_real_instrumented_step_lints_clean():
     # the acceptance gate: the sentinel-instrumented train step passes the
     # full AST rule set (host-sync AND debug-callback) as committed
