@@ -128,6 +128,50 @@ def _chaos_scenario(scenario, step, state, batch, step_time_s, args) -> dict:
     return report
 
 
+def _input_plane_probe(batch_np, global_batch, mesh, step_time_s) -> dict:
+    """Post-timing graft-intake probe: data_stall_ms / input_stall_frac.
+
+    The timed loop drives a FIXED pre-built device batch (so the headline
+    rate measures the step, not the host). This probe runs the real input
+    plane once — a DeviceLoader prefetching over an in-memory dataset —
+    while the consumer sleeps the measured step time between fetches,
+    i.e. the loader sees the same demand pattern training would apply.
+    The counters come from the supervised prefetch worker: ms spent on an
+    empty queue, and the fraction of fetches that stalled at all.
+    """
+    import numpy as np
+
+    import distributed_pytorch_example_tpu as dpx
+
+    class _Mem:
+        def __init__(self, arrays, n):
+            self.arrays, self.n = arrays, n
+
+        def __len__(self):
+            return self.n
+
+        def get_batch(self, indices):
+            idx = np.asarray(indices) % len(next(iter(self.arrays.values())))
+            return {k: v[idx] for k, v in self.arrays.items()}
+
+    steps = 8
+    loader = dpx.data.DeviceLoader(
+        _Mem(batch_np, global_batch * steps), global_batch, mesh=mesh,
+        shuffle=False, prefetch=2, num_shards=1, shard_id=0,
+    )
+    # cap the simulated compute so the probe stays sub-second even for
+    # slow models; the stall FRACTION is what the cap can bias (a shorter
+    # sleep under-feeds the prefetcher), never the headline rate
+    pause = min(step_time_s, 0.1)
+    for _ in loader:
+        time.sleep(pause)
+    served = max(loader.batches_served, 1)
+    return {
+        "data_stall_ms": round(loader.data_stall_ms, 3),
+        "input_stall_frac": round(loader.stalled_batches / served, 4),
+    }
+
+
 def run_serve(args) -> dict:
     """--serve: fixed seeded 32-request replay through the paged-KV
     engine (graft-serve), continuous vs static batching.
@@ -457,6 +501,14 @@ def run_model(name: str, args) -> dict:
             else None
         )
 
+        try:
+            intake_report = _input_plane_probe(
+                batch_np, global_batch, mesh, elapsed / args.steps
+            )
+        except Exception as e:  # noqa: BLE001 - probe must not kill the run
+            print(f"bench: input-plane probe failed: {e}", file=sys.stderr)
+            intake_report = None
+
     samples_per_sec = global_batch * args.steps / elapsed
     unit_kind, baseline = BASELINES[name]
     if unit_kind == "tokens":
@@ -510,6 +562,10 @@ def run_model(name: str, args) -> dict:
     }
     if chaos_report is not None:
         result["chaos"] = chaos_report
+    if intake_report is not None:
+        # graft-intake input-plane health (post-timing probe, not the
+        # timed window): consumer-side prefetch-queue stalls
+        result.update(intake_report)
     if reshard_report is not None:
         result["reshard_ms"] = reshard_report["reshard_ms"]
         result["resume_gap_steps"] = reshard_report["resume_gap_steps"]

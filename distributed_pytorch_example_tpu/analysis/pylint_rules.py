@@ -62,14 +62,17 @@ Nine rules, each a distilled past-regression class:
 
 - ``fleet-unbounded-wait``: a zero-argument ``.get()`` / ``.wait()`` /
   ``.join()`` call (no positional timeout, no ``timeout=`` keyword)
-  inside ``serving/``. graft-fleet's failover contract is that every
-  blocking wait in the serving path is deadline-bounded — an unbounded
-  ``queue.get()`` in a replica worker or ``Event.wait()`` in the router
-  is exactly the silent-hang class the heartbeat deadline exists to
-  catch, and a hang INSIDE the detector is undetectable. Calls with any
-  positional argument never fire (``dict.get(key)``, ``sep.join(xs)``,
-  ``event.wait(0.05)`` are all fine), and ``block=False`` non-blocking
-  gets are fine; everything else must pass ``timeout=``.
+  inside ``serving/`` or ``data/``. graft-fleet's failover contract is
+  that every blocking wait in the serving path is deadline-bounded — an
+  unbounded ``queue.get()`` in a replica worker or ``Event.wait()`` in
+  the router is exactly the silent-hang class the heartbeat deadline
+  exists to catch, and a hang INSIDE the detector is undetectable.
+  graft-intake extends the same contract to the input plane: a training
+  step blocked forever on a dead decode worker's queue is the identical
+  failure with a different costume. Calls with any positional argument
+  never fire (``dict.get(key)``, ``sep.join(xs)``, ``event.wait(0.05)``
+  are all fine), and ``block=False`` non-blocking gets are fine;
+  everything else must pass ``timeout=``.
 
 Scope is static and name-based, not a whole-program call graph — the
 cheap 99% of the check. Deliberate exceptions carry a
@@ -95,6 +98,11 @@ DEBUG_CALLBACK_SCOPE = ("ops/", "train/step.py")
 NAN_LAUNDER_SCOPE = ("ops/", "train/")
 CKPT_STAMP_SCOPE = ("train/checkpoint.py",)
 SERVE_SCOPE = ("serving/",)
+# fleet-unbounded-wait covers every shipped thread-supervision surface:
+# the serving fleet AND the graft-intake input plane (decode workers,
+# prefetch queues) — a bare Queue.get()/Event.wait()/Thread.join() in
+# either can wedge a whole host on one dead peer/worker
+WAIT_SCOPE = ("serving/", "data/")
 
 _ACCUM_CTORS = ("zeros", "zeros_like", "full", "empty")
 
@@ -395,11 +403,12 @@ def _fleet_unbounded_wait_findings(
             rule="fleet-unbounded-wait",
             where=f"{relpath}:{node.lineno}",
             message=(
-                f".{node.func.attr}() without a timeout in the serving "
-                "path: an unbounded blocking wait here can hang a "
-                "replica worker or the router itself forever — outside "
-                "what the heartbeat deadline can detect; pass "
-                "timeout= (graft-fleet failover contract)"
+                f".{node.func.attr}() without a timeout in a supervised "
+                "thread path: an unbounded blocking wait here can hang a "
+                "replica worker, the router, or a training step waiting "
+                "on a dead decode worker forever — outside what the "
+                "heartbeat deadline can detect; pass timeout= "
+                "(graft-fleet/graft-intake supervision contract)"
             ),
         ))
     return [flagged[k] for k in sorted(flagged)]
@@ -584,6 +593,7 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
         findings.extend(_ckpt_stamp_findings(tree, relpath, supp))
     if _in_scope(relpath, SERVE_SCOPE):
         findings.extend(_serve_dynamic_shape_findings(tree, relpath, supp))
+    if _in_scope(relpath, WAIT_SCOPE):
         findings.extend(_fleet_unbounded_wait_findings(tree, relpath, supp))
     return findings
 

@@ -26,3 +26,11 @@ from distributed_pytorch_example_tpu.data.streaming import (  # noqa: F401
     StreamingImageShards,
     write_image_shards,
 )
+from distributed_pytorch_example_tpu.data.intake import (  # noqa: F401
+    PrefetchWorker,
+    ShardCorruptError,
+    loader_manifest,
+    restore_loader_state,
+    seal_file,
+    verify_file,
+)

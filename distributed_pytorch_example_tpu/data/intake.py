@@ -1,0 +1,561 @@
+"""graft-intake: the fault-tolerant input plane.
+
+The data plane was the last production surface with zero fault coverage:
+a flipped bit in a shard file silently poisoned batches, a hung decode
+thread wedged training with no detection, and resume re-derived the
+loader cursor while quarantine/worker state evaporated. This module is
+the shared machinery the rest of the plane builds on:
+
+- **sealed shards** — per-file ``DPX-CRC1`` sidecars (the checkpoint
+  integrity envelope of ``robustness/integrity.py`` applied to data
+  files): :func:`seal_file` writes one, :func:`verify_file` checks it.
+  Files without a sidecar are legacy — readable, unverified — exactly
+  the envelope's own back-compat contract;
+- **deterministic quarantine remap** — :func:`remap_indices` sends the
+  samples of a quarantined shard to intact samples via the SAME
+  SplitMix64 scramble the sampler permutation uses (``data/sampler.py``),
+  so every host computes the identical replacement with no
+  communication;
+- **supervised decode workers** — :class:`PrefetchWorker` promotes the
+  loader's fire-and-forget prefetch thread into a supervised worker:
+  bounded queue with timeouts on every wait, heartbeats, graft-armor
+  ``with_retries`` on transient shard-read ``OSError``, and crash ⇒
+  deterministic restart that re-produces exactly the batch the consumer
+  expects next (batch assembly is a pure function of the batch index);
+- **exact loader-state resume** — :func:`loader_manifest` /
+  :func:`restore_loader_state` stamp (epoch, step cursor, sampler seed,
+  quarantine set) into checkpoints alongside graft-elastic's
+  ``mesh_manifest`` and re-arm them on resume;
+- **multi-host epoch plan** — :func:`epoch_plan_digest` folds
+  (seed, epoch, quarantine digest) into one value every host must agree
+  on; :func:`crosscheck_epoch_plan` exchanges it over the same
+  ``process_allgather`` boundary the straggler exchange uses and hard-
+  fails naming the divergent host.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+import time
+import zlib
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+from distributed_pytorch_example_tpu.robustness import chaos
+from distributed_pytorch_example_tpu.robustness.integrity import (
+    CheckpointCorruptError,
+    seal,
+    unseal,
+)
+from distributed_pytorch_example_tpu.robustness.retry import with_retries
+from distributed_pytorch_example_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+# sidecar next to every sealed data file: seal(<I crc32><Q size>) of the
+# file's bytes — the envelope protects the sidecar itself, the payload
+# protects the data file
+SIDECAR_SUFFIX = ".dpxcrc"
+_SIDECAR_FMT = "<IQ"
+
+LOADER_MANIFEST_KEY = "loader_manifest"
+LOADER_MANIFEST_FORMAT = 1
+
+_GOLDEN = 0x9E3779B97F4A7C15
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+class ShardCorruptError(RuntimeError):
+    """A sealed data shard failed integrity verification (strict mode)."""
+
+
+# ---------------------------------------------------------------------------
+# event sink (Trainer.fit plugs the graft-scope record_event here so
+# quarantine/restart records land in metrics.jsonl as first-class events)
+# ---------------------------------------------------------------------------
+
+_event_sink: Optional[Callable] = None
+
+
+def set_event_sink(sink: Optional[Callable]) -> None:
+    """Install (or clear, with None) the process-wide intake event sink."""
+    global _event_sink
+    _event_sink = sink
+
+
+def emit_event(kind: str, **fields) -> None:
+    """Forward one intake event to the installed sink; always logged."""
+    logger.warning("graft-intake: %s %s", kind, fields)
+    sink = _event_sink
+    if sink is not None:
+        sink(kind, **fields)
+
+
+# ---------------------------------------------------------------------------
+# sealed data files
+# ---------------------------------------------------------------------------
+
+
+def sidecar_path(path: str) -> str:
+    return path + SIDECAR_SUFFIX
+
+
+def seal_file(path: str) -> str:
+    """Write the ``DPX-CRC1`` sidecar for ``path``; returns sidecar path."""
+    with open(path, "rb") as f:
+        data = f.read()
+    body = struct.pack(_SIDECAR_FMT, zlib.crc32(data), len(data))
+    side = sidecar_path(path)
+    with open(side, "wb") as f:
+        f.write(seal(body))
+    return side
+
+
+def verify_file(path: str) -> Optional[bool]:
+    """Check ``path`` against its sidecar.
+
+    ``None`` — no sidecar (legacy data: readable, unverified);
+    ``True`` — sidecar present and the file matches;
+    ``False`` — mismatch, truncation, or a torn sidecar (both cases mean
+    the pair cannot be trusted — attributing which half flipped is moot).
+    """
+    side = sidecar_path(path)
+    if not os.path.exists(side):
+        return None
+    try:
+        with open(side, "rb") as f:
+            body = unseal(f.read(), source=side)
+        crc, size = struct.unpack(_SIDECAR_FMT, body)
+        with open(path, "rb") as f:
+            data = f.read()
+    except (CheckpointCorruptError, OSError, struct.error):
+        return False
+    return len(data) == size and zlib.crc32(data) == crc
+
+
+# ---------------------------------------------------------------------------
+# deterministic quarantine remap (the sampler's SplitMix64 scramble)
+# ---------------------------------------------------------------------------
+
+
+def _splitmix64_array(x: np.ndarray) -> np.ndarray:
+    """Vectorized SplitMix64 finalizer — bit-identical to the scalar
+    ``data/sampler._splitmix64`` stream math."""
+    z = (x.astype(np.uint64) + np.uint64(_GOLDEN)) & np.uint64(_MASK64)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def quarantine_digest(shards: Iterable[int]) -> int:
+    """Order-independent 64-bit digest of a quarantine set (0 = empty)."""
+    d = 0
+    for s in sorted(int(x) for x in set(shards)):
+        d = int(
+            _splitmix64_array(np.asarray([d ^ (s + 1)], np.uint64))[0]
+        )
+    return d
+
+
+def remap_indices(
+    indices: np.ndarray,
+    bad_mask: np.ndarray,
+    intact_pool: np.ndarray,
+    salt: int,
+) -> np.ndarray:
+    """Send masked (quarantined) sample indices to intact ones.
+
+    A pure function of (index, salt): every host computes the identical
+    replacement with no communication, and the replacement stream is
+    decorrelated from the sampler permutation by the salt (callers pass
+    the quarantine digest). The remainder-bias of the modulo draw is the
+    same one Fisher-Yates-by-modulo accepts in ``data/sampler.py``.
+    """
+    if not bad_mask.any():
+        return indices
+    if len(intact_pool) == 0:
+        raise ShardCorruptError(
+            "every shard is quarantined — no intact samples to remap onto"
+        )
+    out = np.asarray(indices).copy()
+    bad = out[bad_mask].astype(np.uint64)
+    draws = _splitmix64_array(
+        (np.uint64(salt) + (bad + np.uint64(1)) * np.uint64(_GOLDEN))
+        & np.uint64(_MASK64)
+    )
+    out[bad_mask] = intact_pool[draws % np.uint64(len(intact_pool))]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# multi-host epoch plan
+# ---------------------------------------------------------------------------
+
+
+def epoch_plan_digest(
+    seed: int, epoch: int, quarantine: Iterable[int]
+) -> int:
+    """One 64-bit value summarizing this epoch's global sample plan.
+
+    The global order is a pure function of (seed, epoch) and the remap a
+    pure function of the quarantine set, so hosts whose digests agree
+    will produce identical global batches.
+    """
+    x = np.asarray(
+        [int(seed) & _MASK64, int(epoch) & _MASK64,
+         quarantine_digest(quarantine)],
+        np.uint64,
+    )
+    d = np.uint64(0)
+    for v in _splitmix64_array(x):
+        d = _splitmix64_array(np.asarray([d ^ v], np.uint64))[0]
+    return int(d)
+
+
+def check_plan_agreement(
+    digests: np.ndarray, epoch: int
+) -> None:
+    """Hard-fail naming the divergent host(s) on any digest mismatch."""
+    digests = np.asarray(digests, np.uint64).reshape(-1)
+    values, counts = np.unique(digests, return_counts=True)
+    if len(values) <= 1:
+        return
+    majority = values[int(np.argmax(counts))]
+    divergent = [
+        int(i) for i, d in enumerate(digests) if d != majority
+    ]
+    raise RuntimeError(
+        f"graft-intake: epoch {epoch} plan mismatch — host(s) {divergent} "
+        f"computed a different (seed, epoch, quarantine) digest than the "
+        f"majority ({[hex(int(d)) for d in digests]}). Divergent "
+        "quarantine sets or seeds would silently feed hosts different "
+        "samples; refusing to train."
+    )
+
+
+def crosscheck_epoch_plan(loader, epoch: int) -> Optional[int]:
+    """Exchange the epoch-plan digest across hosts; returns the digest.
+
+    No-op (returns None) for loaders without a sampler and at world size
+    1. Collective: every process calls this at the same epoch boundary
+    (the Trainer's epoch loop is symmetric by construction).
+    """
+    sampler = getattr(loader, "sampler", None)
+    if sampler is None:
+        return None
+    quarantine = getattr(
+        getattr(loader, "dataset", None), "quarantined_shards", None
+    ) or ()
+    digest = epoch_plan_digest(sampler.seed, epoch, quarantine)
+    import jax
+
+    if jax.process_count() == 1:
+        return digest
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray([digest], np.uint64)
+    )
+    check_plan_agreement(np.asarray(gathered).reshape(-1), epoch)
+    return digest
+
+
+# ---------------------------------------------------------------------------
+# supervised prefetch worker
+# ---------------------------------------------------------------------------
+
+# every wait is bounded (the fleet-unbounded-wait lint contract, extended
+# to data/): the ticks below are supervision poll cadences, not deadlines
+_PUT_TICK_S = 0.1
+_GET_TICK_S = 0.2
+_JOIN_S = 5.0
+
+
+class PrefetchWorker:
+    """Supervised bounded-queue producer over ``make_batch(i)``.
+
+    ``make_batch`` must be a pure function of the batch index ``i`` (the
+    loader's batch assembly is: sampler permutation is (seed, epoch)-
+    deterministic), which is what makes crash recovery exact — a restart
+    at the consumer's cursor re-produces precisely the batch the dead
+    worker owed.
+
+    Supervision contract:
+
+    - transient ``OSError`` from ``make_batch`` (flaky shard I/O) is
+      retried in place with graft-armor backoff (``retries`` attempts);
+    - a worker crash (any other exception, including the injected
+      ``kill-decode-worker`` chaos fault) or a stale heartbeat restarts
+      the worker at the consumer cursor, up to ``max_restarts`` times per
+      iteration; exhaustion re-raises the last error;
+    - every queue wait carries a timeout; abandoning the consumer calls
+      :meth:`close`, which stops and joins the worker (no leaked thread).
+    """
+
+    def __init__(
+        self,
+        make_batch: Callable[[int], object],
+        start: int,
+        stop: int,
+        maxsize: int,
+        name: str = "intake",
+        telemetry=None,
+        retries: int = 4,
+        max_restarts: int = 3,
+        heartbeat_timeout_s: float = 60.0,
+    ):
+        self._make = make_batch
+        self._start = start
+        self._stop_index = stop
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, maxsize))
+        self._name = name
+        self._telemetry = telemetry
+        self._retries = max(1, retries)
+        self._max_restarts = max_restarts
+        self._heartbeat_timeout_s = heartbeat_timeout_s
+        self._closed = False
+        self._next_get = start
+        # counters (read by the loader / bench probe)
+        self.stall_ms = 0.0
+        self.gets = 0
+        self.empty_gets = 0
+        self.restarts = 0
+        self.io_retries = 0
+        self._gen = 0
+        self._current: dict = {}
+        if start < stop:
+            self._spawn(start)
+
+    # -- producer ----------------------------------------------------------
+
+    def _spawn(self, start: int) -> None:
+        self._gen += 1
+        state = {
+            "gen": self._gen,
+            "stop": threading.Event(),
+            "err": None,
+            "done": False,
+            "heartbeat": time.monotonic(),
+        }
+        self._current = state
+
+        def run() -> None:
+            gen, stop = state["gen"], state["stop"]
+            try:
+                for i in range(start, self._stop_index):
+                    chaos.decode_worker(i)
+                    item = with_retries(
+                        lambda i=i: self._make(i),
+                        attempts=self._retries,
+                        retry_on=(OSError,),
+                        describe=f"{self._name} batch {i} read",
+                        on_retry=self._count_retry,
+                    )
+                    placed = False
+                    while not stop.is_set():
+                        state["heartbeat"] = time.monotonic()
+                        try:
+                            self._q.put((gen, i, item), timeout=_PUT_TICK_S)
+                            placed = True
+                            break
+                        except queue.Full:
+                            continue
+                    if not placed:
+                        return
+            except BaseException as e:  # surfaced by the supervisor
+                state["err"] = e
+            finally:
+                state["done"] = True
+
+        t = threading.Thread(
+            target=run, daemon=True, name=f"intake-{self._name}"
+        )
+        state["thread"] = t
+        t.start()
+
+    def _count_retry(self, attempt: int, err: BaseException) -> None:
+        self.io_retries += 1
+        emit_event(
+            "shard_read_retry", worker=self._name, attempt=attempt + 1,
+            error=str(err),
+        )
+
+    # -- supervisor (consumer side) ---------------------------------------
+
+    def _supervise(self) -> None:
+        state = self._current
+        thread = state.get("thread")
+        if thread is None:
+            return
+        if thread.is_alive():
+            stale = time.monotonic() - state["heartbeat"]
+            if stale > self._heartbeat_timeout_s:
+                self._restart(
+                    f"heartbeat stale for {stale:.1f}s (hung decode)",
+                    None,
+                )
+            return
+        if state["err"] is not None:
+            self._restart(f"worker crashed: {state['err']!r}", state["err"])
+        elif state["done"] and self._q.empty():
+            # finished its range yet the consumer still expects batches
+            # (stale-generation drops); re-produce from the cursor
+            self._restart("worker finished early", None)
+
+    def _restart(self, reason: str, err) -> None:
+        self.restarts += 1
+        if self._max_restarts and self.restarts > self._max_restarts:
+            raise err if err is not None else RuntimeError(
+                f"{self._name}: decode worker restart budget "
+                f"({self._max_restarts}) exhausted: {reason}"
+            )
+        self._current["stop"].set()
+        self._drain()
+        emit_event(
+            "decode_worker_restart", worker=self._name, reason=reason,
+            batch=self._next_get, restarts=self.restarts,
+        )
+        self._spawn(self._next_get)
+
+    def _drain(self) -> None:
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                return
+
+    # -- consumer ----------------------------------------------------------
+
+    def next_batch(self):
+        """Next batch in index order, or ``None`` when the range is done.
+
+        Counts the wait as a stall only when the queue was empty on entry
+        (the producer fell behind the consumer — the input-bound signal
+        ``input_stall_frac`` aggregates).
+        """
+        if self._closed or self._next_get >= self._stop_index:
+            return None
+        stalled = self._q.empty()
+        t0 = time.perf_counter()
+        while True:
+            try:
+                gen, i, item = self._q.get(timeout=_GET_TICK_S)
+            except queue.Empty:
+                self._supervise()
+                continue
+            if gen == self._current.get("gen") and i == self._next_get:
+                break
+            # stale generation (pre-restart zombie) or already-consumed
+            # index: drop and keep waiting for the cursor batch
+        waited_ms = (time.perf_counter() - t0) * 1000.0
+        self._next_get += 1
+        self.gets += 1
+        if stalled:
+            self.empty_gets += 1
+            self.stall_ms += waited_ms
+        scope = self._telemetry
+        if scope is not None and hasattr(scope, "record_data_wait"):
+            scope.record_data_wait(waited_ms, stalled)
+        return item
+
+    def close(self) -> None:
+        """Stop the producer, drain the queue, and join the thread."""
+        if self._closed:
+            return
+        self._closed = True
+        state = self._current
+        stop = state.get("stop")
+        if stop is not None:
+            stop.set()
+        self._drain()
+        thread = state.get("thread")
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=_JOIN_S)
+            self._drain()  # a put landing during join must not strand it
+        err = state.get("err")
+        if err is not None and not isinstance(err, GeneratorExit):
+            logger.warning(
+                "graft-intake: worker %s closed with pending error: %r",
+                self._name, err,
+            )
+
+
+# ---------------------------------------------------------------------------
+# exact loader-state resume
+# ---------------------------------------------------------------------------
+
+
+def loader_manifest(
+    loader, epoch: int, batch_in_epoch: int
+) -> Optional[dict]:
+    """The checkpoint stamp for a DeviceLoader-shaped loader, or None.
+
+    Captures everything resume needs to repeat no sample and skip none:
+    the cursor, the sampler seed (the permutation is a pure function of
+    seed + epoch), and the quarantine set (the remap is a pure function
+    of it). The cursor is in GLOBAL-batch steps, so it transfers across
+    an elastic dp8→dp4 reshape unchanged — step ``s`` covers global
+    permutation positions ``[s*gbs, (s+1)*gbs)`` for any shard count.
+    """
+    sampler = getattr(loader, "sampler", None)
+    if sampler is None:
+        return None
+    quarantine = getattr(
+        getattr(loader, "dataset", None), "quarantined_shards", None
+    )
+    qlist = sorted(int(s) for s in quarantine) if quarantine else []
+    return {
+        "format": LOADER_MANIFEST_FORMAT,
+        "epoch": int(epoch),
+        "batch_in_epoch": int(batch_in_epoch),
+        "seed": int(sampler.seed),
+        "shuffle": bool(sampler.shuffle),
+        "quarantine": qlist,
+        "quarantine_digest": quarantine_digest(qlist),
+    }
+
+
+def restore_loader_state(
+    loader, manifest: dict, on_event: Optional[Callable] = None
+) -> int:
+    """Re-arm a loader from a stamped ``loader_manifest``; returns the
+    batch cursor to resume at.
+
+    The seed must match — a different seed means a different global
+    permutation, and silently resuming on it would repeat and skip
+    samples, which is exactly the contract this stamp exists to prevent.
+    """
+    sampler = getattr(loader, "sampler", None)
+    if sampler is None:
+        raise ValueError(
+            "checkpoint carries a loader_manifest but the training loader "
+            "has no sampler to restore it onto"
+        )
+    saved_seed = int(manifest.get("seed", sampler.seed))
+    if saved_seed != int(sampler.seed):
+        raise ValueError(
+            f"loader_manifest seed {saved_seed} != training loader seed "
+            f"{sampler.seed}: resuming would permute samples differently, "
+            "repeating some and skipping others. Pass the original seed."
+        )
+    quarantine = [int(s) for s in manifest.get("quarantine", [])]
+    if quarantine:
+        dataset = getattr(loader, "dataset", None)
+        mark = getattr(dataset, "quarantine", None)
+        if callable(mark):
+            mark(quarantine, reason="restored from loader_manifest")
+        else:
+            emit_event(
+                "loader_manifest_quarantine_unsupported",
+                quarantine=quarantine,
+            )
+        if on_event is not None:
+            on_event(
+                "loader_quarantine_restored", shards=quarantine,
+                epoch=int(manifest.get("epoch", 0)),
+            )
+    return int(manifest.get("batch_in_epoch", 0))

@@ -12,8 +12,13 @@ shard; JAX gives one process per *host* feeding all local devices. So:
   loader forms a single global ``jax.Array`` sharded over the mesh's data
   axes (``jax.make_array_from_process_local_data``), so the jitted train step
   sees one logical batch regardless of topology;
-- a background thread pre-assembles and pre-transfers the next batches
-  (replaces ``num_workers=2`` + ``pin_memory`` H2D overlap, train.py:112-113).
+- a SUPERVISED background worker pre-assembles and pre-transfers the next
+  batches (replaces ``num_workers=2`` + ``pin_memory`` H2D overlap,
+  train.py:112-113): graft-intake's :class:`~.intake.PrefetchWorker` —
+  bounded queue with timeouts on every wait, heartbeats, bounded retry on
+  transient shard-read ``OSError``, and crash ⇒ deterministic restart
+  that re-produces exactly the batch the consumer expects next (batch
+  assembly is a pure function of the batch index).
 
 Static shapes: the final partial batch is padded by wrapping (same spirit as
 ``DistributedSampler``'s wrap-padding) so every step has identical shape and
@@ -22,12 +27,11 @@ XLA never recompiles; ``drop_last=True`` drops it instead.
 
 from __future__ import annotations
 
-import queue
-import threading
 from typing import Any, Dict, Iterator, Optional, Sequence
 
 import numpy as np
 
+from distributed_pytorch_example_tpu.data import intake
 from distributed_pytorch_example_tpu.data.sampler import ShardedSampler
 from distributed_pytorch_example_tpu.runtime import mesh as mesh_lib
 
@@ -88,8 +92,17 @@ class DeviceLoader:
         self.prefetch = prefetch
         # graft-scope hook: Trainer.fit attaches its Telemetry scope here so
         # host->device transfers emit "h2d" trace spans (the prefetch
-        # thread's track in the trace); None = no tracing
+        # thread's track in the trace) and consumer-side queue waits land
+        # in the per-boundary data_stall_ms counter; None = no tracing
         self.telemetry = None
+        # graft-intake counters, accumulated across iterations (read by
+        # the bench input-plane probe and operators): consumer stalls,
+        # worker restarts, retried shard reads
+        self.data_stall_ms = 0.0
+        self.batches_served = 0
+        self.stalled_batches = 0
+        self.worker_restarts = 0
+        self.io_retries = 0
         if drop_last:
             self.steps_per_epoch = len(self.sampler) // self.local_batch_size
         else:
@@ -110,16 +123,28 @@ class DeviceLoader:
     def __len__(self) -> int:
         return self.steps_per_epoch
 
-    def _host_batches(
-        self, start_step: int = 0
-    ) -> Iterator[Dict[str, np.ndarray]]:
+    def _epoch_indices(self) -> np.ndarray:
+        """This epoch's padded shard-local index order (pure fn of epoch)."""
         indices = self.sampler.shard_indices()
         n = self.steps_per_epoch * self.local_batch_size
         if n > len(indices):  # wrap-pad the final partial batch
             indices = np.concatenate([indices, indices[: n - len(indices)]])
+        return indices
+
+    def _assemble(self, step: int, indices: np.ndarray) -> Dict[str, np.ndarray]:
+        """Host batch for one step — a pure function of (epoch, step), the
+        property that makes supervised-worker restart exact."""
+        lo = step * self.local_batch_size
+        return _get_batch(
+            self.dataset, indices[lo : lo + self.local_batch_size]
+        )
+
+    def _host_batches(
+        self, start_step: int = 0
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        indices = self._epoch_indices()
         for step in range(start_step, self.steps_per_epoch):
-            lo = step * self.local_batch_size
-            yield _get_batch(self.dataset, indices[lo : lo + self.local_batch_size])
+            yield self._assemble(step, indices)
 
     def _to_device(self, host_batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
         import contextlib
@@ -150,6 +175,14 @@ class DeviceLoader:
         function of (seed, epoch), so skipping the first ``start_step``
         batches reproduces EXACTLY the batches an uninterrupted run would
         have seen — skipped batches are never assembled or transferred.
+
+        The prefetch path runs under graft-intake supervision
+        (:class:`~.intake.PrefetchWorker`): worker crashes restart at the
+        consumer cursor re-producing the exact batch, transient shard-read
+        ``OSError`` is retried in place, and abandoning this generator
+        mid-epoch (``GeneratorExit`` — e.g. a ``BadStepBudgetExceeded``
+        rollback unwinding the epoch) stops, drains, and JOINS the worker
+        instead of leaking a thread blocked on a full queue.
         """
         if not 0 <= start_step <= self.steps_per_epoch:
             raise ValueError(
@@ -160,26 +193,27 @@ class DeviceLoader:
                 yield self._to_device(hb)
             return
 
-        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
-        sentinel = object()
-        err: list = []
-
-        def producer():
-            try:
-                for hb in self._host_batches(start_step):
-                    q.put(self._to_device(hb))
-            except BaseException as e:  # surfaced in the consumer
-                err.append(e)
-            finally:
-                q.put(sentinel)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            yield item
-        t.join()
-        if err:
-            raise err[0]
+        indices = self._epoch_indices()
+        worker = intake.PrefetchWorker(
+            make_batch=lambda i: self._to_device(
+                self._assemble(i, indices)
+            ),
+            start=start_step,
+            stop=self.steps_per_epoch,
+            maxsize=self.prefetch,
+            name=f"loader-shard{self.sampler.shard_id}",
+            telemetry=self.telemetry,
+        )
+        try:
+            while True:
+                item = worker.next_batch()
+                if item is None:
+                    break
+                self.batches_served += 1
+                yield item
+        finally:
+            worker.close()
+            self.data_stall_ms += worker.stall_ms
+            self.stalled_batches += worker.empty_gets
+            self.worker_restarts += worker.restarts
+            self.io_retries += worker.io_retries

@@ -23,6 +23,15 @@ Labels are small (4 bytes/sample) and load fully into RAM up front.
 tests and by offline ImageNet decode jobs (decode-to-uint8-npy once, train
 many times; the reference's decode-per-epoch ``num_workers=2`` loader,
 train.py:112, has no TPU-side analogue worth copying).
+
+graft-intake sealing: ``write_image_shards(..., seal=True)`` writes a
+per-file ``DPX-CRC1`` sidecar (data/intake.py — the checkpoint integrity
+envelope applied to shard files). The reader verifies each shard lazily
+on first touch; a corrupt sealed shard is **quarantined** — logged,
+excluded, and its samples deterministically remapped onto intact shards
+via the sampler's SplitMix64 scramble — instead of poisoning a batch
+(``integrity="strict"`` hard-fails instead; unsealed shards load
+unverified, the envelope's own legacy contract).
 """
 
 from __future__ import annotations
@@ -34,7 +43,12 @@ from typing import Callable, Dict, Iterable, Optional, Tuple
 
 import numpy as np
 
+from distributed_pytorch_example_tpu.data import intake
+from distributed_pytorch_example_tpu.robustness import chaos
+
 _SHARD_RE = re.compile(r"^images_(\d+)\.npy$")
+
+_INTEGRITY_MODES = ("quarantine", "strict", "off")
 
 
 class StreamingImageShards:
@@ -46,6 +60,12 @@ class StreamingImageShards:
 
     ``transform``: optional ``fn(batch_dict) -> batch_dict`` applied after
     normalization (augmentation hook; runs on host in the prefetch thread).
+
+    ``integrity``: what to do when a sealed shard fails its sidecar check
+    on first touch — ``"quarantine"`` (default: exclude the shard, remap
+    its samples deterministically onto intact shards), ``"strict"``
+    (raise :class:`~..data.intake.ShardCorruptError`), or ``"off"`` (skip
+    verification entirely). Unsealed shards are never checked.
     """
 
     def __init__(
@@ -55,7 +75,14 @@ class StreamingImageShards:
         transform: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]] = None,
         max_open_shards: int = 8,
         raw_uint8: bool = False,
+        integrity: str = "quarantine",
     ):
+        if integrity not in _INTEGRITY_MODES:
+            raise ValueError(
+                f"integrity must be one of {_INTEGRITY_MODES}, "
+                f"got {integrity!r}"
+            )
+        self.integrity = integrity
         if not os.path.isdir(root):
             raise FileNotFoundError(
                 f"Shard root {root!r} does not exist. Expected "
@@ -80,10 +107,20 @@ class StreamingImageShards:
         if missing:
             raise FileNotFoundError(f"Missing label shard {missing[0]!r}")
 
+        # graft-intake quarantine state must exist before the label loop:
+        # image shards verify lazily on first batch touch (_resolve);
+        # label shards are fully read here, so they verify eagerly — a
+        # corrupt sealed label shard quarantines (or hard-fails) before
+        # its bytes are ever parsed
+        self.quarantined_shards: set = set()
+        self._verified: set = set()
+        self._intact_cache: Optional[np.ndarray] = None
+        self._open: OrderedDict[int, np.memmap] = OrderedDict()
+
         lengths = []
         labels = []
         self.image_shape: Optional[Tuple[int, ...]] = None
-        for p, lp in zip(self._image_paths, label_paths):
+        for shard, (p, lp) in enumerate(zip(self._image_paths, label_paths)):
             shape, dtype = _npy_header(p)
             if dtype != np.uint8:
                 raise ValueError(f"{p}: image shards must be uint8, got {dtype}")
@@ -94,12 +131,21 @@ class StreamingImageShards:
                     f"{p}: shard image shape {shape[1:]} != first shard's "
                     f"{self.image_shape}"
                 )
-            shard_labels = np.load(lp).astype(np.int32)
-            if len(shard_labels) != shape[0]:
-                raise ValueError(
-                    f"{lp}: {len(shard_labels)} labels != {shape[0]} image "
-                    f"rows in {p}"
-                )
+            if (
+                self.integrity != "off"
+                and intake.verify_file(lp) is False
+            ):
+                self._quarantine_shard(shard, lp, "label sidecar mismatch")
+                # placeholder rows keep the global index space stable;
+                # the quarantine remap guarantees they are never served
+                shard_labels = np.zeros(shape[0], np.int32)
+            else:
+                shard_labels = np.load(lp).astype(np.int32)
+                if len(shard_labels) != shape[0]:
+                    raise ValueError(
+                        f"{lp}: {len(shard_labels)} labels != {shape[0]} "
+                        f"image rows in {p}"
+                    )
             labels.append(shard_labels)
             lengths.append(shape[0])
         self.labels = np.concatenate(labels)
@@ -115,7 +161,7 @@ class StreamingImageShards:
         self.normalize = normalize
         self.transform = transform
         self.max_open_shards = max(1, max_open_shards)
-        self._open: OrderedDict[int, np.memmap] = OrderedDict()
+        self._label_paths = label_paths
 
     def __len__(self) -> int:
         return int(self._starts[-1])
@@ -135,13 +181,103 @@ class StreamingImageShards:
             del old
             if mm is not None:
                 mm.close()
+        chaos.shard_read(self._image_paths[shard])  # slow-shard-io site
         m = np.load(self._image_paths[shard], mmap_mode="r")
         self._open[shard] = m
         return m
 
+    # -- graft-intake: seal verification + quarantine ----------------------
+
+    def _quarantine_shard(self, shard: int, path: str, reason: str) -> None:
+        if self.integrity == "strict":
+            raise intake.ShardCorruptError(
+                f"{path}: {reason} (integrity='strict'); the shard file "
+                "is corrupt or its sidecar is torn"
+            )
+        if shard in self.quarantined_shards:
+            return
+        self.quarantined_shards.add(shard)
+        self._intact_cache = None
+        self._open.pop(shard, None)
+        intake.emit_event(
+            "shard_quarantine", shard=int(shard), path=path, reason=reason,
+            quarantined=sorted(int(s) for s in self.quarantined_shards),
+        )
+
+    def quarantine(self, shards, reason: str = "operator request") -> None:
+        """Pre-arm the quarantine set (loader_manifest resume, tests)."""
+        for shard in shards:
+            shard = int(shard)
+            if not 0 <= shard < len(self._image_paths):
+                raise ValueError(
+                    f"shard {shard} out of range "
+                    f"[0, {len(self._image_paths)})"
+                )
+            self._quarantine_shard(
+                shard, self._image_paths[shard], reason
+            )
+
+    def _ensure_verified(self, shard: int) -> None:
+        """Lazy first-touch seal check of one image shard."""
+        if (
+            self.integrity == "off"
+            or shard in self._verified
+            or shard in self.quarantined_shards
+        ):
+            return
+        path = self._image_paths[shard]
+        chaos.shard_read(path)  # corrupt-shard / slow-shard-io site
+        if intake.verify_file(path) is False:
+            self._quarantine_shard(shard, path, "image sidecar mismatch")
+        else:  # verified intact, or unsealed legacy (None): serve as-is
+            self._verified.add(shard)
+
+    def _intact_pool(self) -> np.ndarray:
+        """All sample indices living in non-quarantined shards (cached)."""
+        if self._intact_cache is None:
+            keep = [
+                s for s in range(len(self._image_paths))
+                if s not in self.quarantined_shards
+            ]
+            self._intact_cache = np.concatenate(
+                [np.arange(self._starts[s], self._starts[s + 1])
+                 for s in keep] or [np.empty(0, np.int64)]
+            ).astype(np.int64)
+        return self._intact_cache
+
+    def _resolve(self, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Verify touched shards and remap quarantined samples.
+
+        Returns (indices, shard_ids) touching only intact shards. The
+        remap is a pure function of (index, quarantine set), so every
+        host serves the identical replacement; a remap target landing on
+        a not-yet-verified shard that then fails verification re-remaps
+        (bounded by the shard count).
+        """
+        indices = np.asarray(indices, np.int64)
+        for _ in range(len(self._image_paths) + 1):
+            shard_ids = (
+                np.searchsorted(self._starts, indices, side="right") - 1
+            )
+            for shard in np.unique(shard_ids):
+                self._ensure_verified(int(shard))
+            if not self.quarantined_shards:
+                return indices, shard_ids
+            bad = np.isin(
+                shard_ids, np.asarray(sorted(self.quarantined_shards))
+            )
+            if not bad.any():
+                return indices, shard_ids
+            indices = intake.remap_indices(
+                indices, bad, self._intact_pool(),
+                salt=intake.quarantine_digest(self.quarantined_shards),
+            )
+        raise intake.ShardCorruptError(
+            "quarantine remap failed to converge — no intact shards left"
+        )
+
     def get_batch(self, indices: np.ndarray) -> Dict[str, np.ndarray]:
-        indices = np.asarray(indices)
-        shard_ids = np.searchsorted(self._starts, indices, side="right") - 1
+        indices, shard_ids = self._resolve(np.asarray(indices))
         dtype = np.uint8 if self.raw_uint8 else np.float32
         x = np.empty((len(indices), *self.image_shape), dtype)
         # group rows by shard: one map touch per shard per batch, ascending
@@ -167,12 +303,15 @@ def write_image_shards(
     root: str,
     batches: Iterable[Tuple[np.ndarray, np.ndarray]],
     shard_size: int = 4096,
+    seal: bool = False,
 ) -> int:
     """Write (images uint8 NHWC, labels) batches into the shard layout.
 
     Re-chunks arbitrary incoming batch sizes into ``shard_size``-row shards;
     returns the number of shards written. Offline tool — decode once, train
-    many times.
+    many times. ``seal=True`` writes a ``DPX-CRC1`` sidecar per file
+    (data/intake.py) so the reader can verify shards on first touch and
+    quarantine flipped bits instead of training on them.
     """
     os.makedirs(root, exist_ok=True)
     buf_x: list = []
@@ -184,8 +323,11 @@ def write_image_shards(
         nonlocal buf_x, buf_y, buffered, shard
         x = np.concatenate(buf_x)
         y = np.concatenate(buf_y)
-        np.save(os.path.join(root, f"images_{shard:05d}.npy"), x[:n])
-        np.save(os.path.join(root, f"labels_{shard:05d}.npy"), y[:n])
+        for prefix, arr in (("images", x[:n]), ("labels", y[:n])):
+            path = os.path.join(root, f"{prefix}_{shard:05d}.npy")
+            np.save(path, arr)
+            if seal:
+                intake.seal_file(path)
         buf_x, buf_y, buffered = [x[n:]], [y[n:]], len(x) - n
         shard += 1
 

@@ -59,11 +59,35 @@ class TokenWindowDataset:
         return {"tokens": np.asarray(out, np.int32)}
 
 
+def write_token_file(
+    path: str, ids: np.ndarray, seal: bool = True
+) -> str:
+    """Write a flat token array as ``.npy`` or raw ``.bin`` (graft-intake).
+
+    The memmap-writer counterpart of ``streaming.write_image_shards``:
+    ``seal=True`` (default — corpora are written once, read for months)
+    adds the ``DPX-CRC1`` sidecar :func:`load_token_file` verifies.
+    """
+    ids = np.asarray(ids)
+    if ids.ndim != 1:
+        raise ValueError(f"expected flat token array, got shape {ids.shape}")
+    if path.endswith(".npy"):
+        np.save(path, ids)
+    else:
+        ids.tofile(path)
+    if seal:
+        from distributed_pytorch_example_tpu.data import intake
+
+        intake.seal_file(path)
+    return path
+
+
 def load_token_file(
     path: str,
     seq_len: int,
     dtype: str = "uint16",
     stride: Optional[int] = None,
+    verify: bool = True,
 ) -> TokenWindowDataset:
     """Load a tokenized corpus from ``.npy`` or raw ``.bin``.
 
@@ -71,12 +95,28 @@ def load_token_file(
     GPT-2's 50257 vocab — the standard nanoGPT-style preprocessing output).
     Both formats are memory-mapped, so multi-GB corpora never fully load;
     pages fault in as windows are gathered.
+
+    ``verify=True`` checks the corpus against its ``DPX-CRC1`` sidecar
+    when one exists (``write_token_file(..., seal=True)``) and raises
+    :class:`~..data.intake.ShardCorruptError` on a mismatch — a flipped
+    bit in a token file would otherwise train silently on garbage ids.
+    Sidecar-less corpora load unverified (legacy contract). The check is
+    one sequential read at open, not per-window work.
     """
     if not os.path.exists(path):
         raise FileNotFoundError(
             f"Token file {path!r} not found. This environment has no network "
             "egress — pre-tokenize offline, or use --dataset synthetic-tokens."
         )
+    if verify:
+        from distributed_pytorch_example_tpu.data import intake
+
+        if intake.verify_file(path) is False:
+            raise intake.ShardCorruptError(
+                f"{path}: token file failed its DPX-CRC1 sidecar check — "
+                "corrupt corpus (re-run the offline tokenize, or pass "
+                "verify=False to load it anyway)"
+            )
     if path.endswith(".npy"):
         ids = np.load(path, mmap_mode="r")
     else:

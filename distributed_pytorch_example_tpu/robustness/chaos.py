@@ -42,7 +42,17 @@ Fault kinds:
   elsewhere bit-identically (serving/fleet.py, serving/router.py);
 - ``flaky-channel`` — transient ``OSError`` on the next ``count``
   dispatches to replica ``at`` (empty = any replica), exercising the
-  router's bounded dispatch retry (robustness/retry.py).
+  router's bounded dispatch retry (robustness/retry.py);
+- ``corrupt-shard`` — bit-flip the data-shard file whose path contains
+  ``path_substr`` on the ``nth`` read touch (graft-intake: the sealed
+  sidecar catches it at first verification and the shard is
+  quarantined, data/streaming.py);
+- ``slow-shard-io`` — sleep ``delay_s`` on the next ``count`` shard
+  read touches matching ``path_substr`` (input-bound steps must show up
+  as ``data_stall_ms``, not silently stretch the step time);
+- ``kill-decode-worker`` — crash the supervised prefetch worker at the
+  first produced batch index ``>= step`` (fires once; the supervisor
+  must restart it re-producing the exact batch, data/intake.py).
 """
 
 from __future__ import annotations
@@ -63,6 +73,7 @@ ENV_VAR = "DPX_CHAOS"
 KINDS = (
     "nan-batch", "inf-batch", "io-error", "kill", "rendezvous-flake",
     "poison-request", "kill-replica", "stall-replica", "flaky-channel",
+    "corrupt-shard", "slow-shard-io", "kill-decode-worker",
 )
 
 
@@ -372,6 +383,60 @@ def flaky_channel(replica_id: str) -> None:
             raise OSError(
                 errno.EIO,
                 f"chaos: injected flaky channel to replica {replica_id}",
+            )
+
+
+def shard_read(path: str) -> None:
+    """Data-shard read touch (graft-intake): ``corrupt-shard`` bit-flips
+    the file on disk at the ``nth`` matching touch (the sealed sidecar
+    must catch it on verification); ``slow-shard-io`` sleeps ``delay_s``
+    for the next ``count`` matching touches."""
+    plan = active()
+    if plan is None:
+        return
+    for fault in plan.faults:
+        if fault.kind == "corrupt-shard" and fault.path_substr in path:
+            fault.fired += 1
+            if fault.fired == fault.nth:
+                logger.warning(
+                    "chaos: corrupting shard %s (touch %d)",
+                    path, fault.fired,
+                )
+                corrupt_file(path, mode="bitflip", seed=plan.seed)
+        elif (
+            fault.kind == "slow-shard-io"
+            and fault.path_substr in path
+            and fault.fired < fault.count
+        ):
+            fault.fired += 1
+            delay = fault.delay_s or 0.05
+            logger.warning(
+                "chaos: slow shard I/O on %s — sleeping %.3fs (%d/%d)",
+                path, delay, fault.fired, fault.count,
+            )
+            time.sleep(delay)
+
+
+def decode_worker(batch_index: int) -> None:
+    """Supervised-prefetch-worker crash site (graft-intake): a
+    ``kill-decode-worker`` fault raises inside the producer at the first
+    produced batch index ``>= step``, once (`>=` keeps the plan
+    replayable when the restart re-produces earlier indices)."""
+    plan = active()
+    if plan is None:
+        return
+    for fault in plan.faults:
+        if (
+            fault.kind == "kill-decode-worker"
+            and fault.fired == 0
+            and 0 <= fault.step <= batch_index
+        ):
+            fault.fired += 1
+            logger.warning(
+                "chaos: killing decode worker at batch %d", batch_index
+            )
+            raise RuntimeError(
+                f"chaos: decode worker killed at batch {batch_index}"
             )
 
 

@@ -85,6 +85,11 @@ class Telemetry:
         self.last_straggler: Dict[str, object] = {}
         self.overhead_s = 0.0
         self.events: list = []  # recovery/fault events (graft-armor)
+        # graft-intake window counters: consumer-side waits on the input
+        # plane's prefetch queue since the last boundary (reset per record)
+        self._data_wait_ms = 0.0
+        self._data_waits = 0
+        self._data_stalls = 0
         self._closed = False
 
     # -- spans ------------------------------------------------------------
@@ -140,6 +145,21 @@ class Telemetry:
         if self.writer is not None:
             self.writer.write(record)
         return record
+
+    # -- input plane (graft-intake) ---------------------------------------
+
+    def record_data_wait(self, waited_ms: float, stalled: bool) -> None:
+        """One consumer-side wait on the input plane's prefetch queue.
+
+        Called by :class:`~..data.intake.PrefetchWorker` from the training
+        thread (NOT the worker thread — no locking needed). ``stalled``
+        means the queue was empty when the consumer arrived, i.e. this
+        step boundary genuinely waited on data rather than compute.
+        """
+        self._data_waits += 1
+        if stalled:
+            self._data_wait_ms += waited_ms
+            self._data_stalls += 1
 
     # -- per-step ---------------------------------------------------------
 
@@ -198,6 +218,17 @@ class Telemetry:
             **scalars,
             **straggler,
         }
+        if self._data_waits:
+            # per-boundary input-plane health: total ms the consumer sat on
+            # an empty prefetch queue, and the fraction of batch fetches in
+            # this window that stalled at all
+            record["data_stall_ms"] = round(self._data_wait_ms, 3)
+            record["input_stall_frac"] = round(
+                self._data_stalls / self._data_waits, 4
+            )
+            self._data_wait_ms = 0.0
+            self._data_waits = 0
+            self._data_stalls = 0
         self.last_record = record
         if self.writer is not None and self.config.every > 0:
             self.writer.write(record)

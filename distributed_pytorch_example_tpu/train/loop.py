@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from distributed_pytorch_example_tpu.data import intake
 from distributed_pytorch_example_tpu.parallel.api import Partitioner
 from distributed_pytorch_example_tpu.robustness import (
     BadStepBudgetExceeded,
@@ -194,6 +195,9 @@ class Trainer:
         self._pending_bad: List[Any] = []  # device flags, drained at bounds
         self._bad_since_recovery = 0
         self._rolled_back = False
+        # input-plane events fired before fit's scope exists (see
+        # _record_event); flushed into the scope on creation
+        self._pending_events: List[Any] = []
 
     def _sharded_ckpt(self) -> bool:
         """auto: sharded at multi-host scale (collective-free async saves,
@@ -331,6 +335,11 @@ class Trainer:
         self, loader, epoch: int, start_batch: int = 0
     ) -> Dict[str, float]:
         loader.set_epoch(epoch)
+        # graft-intake: every host must derive the SAME epoch plan from
+        # (seed, epoch, quarantine set); a diverged host silently trains on
+        # the wrong samples, so the digest is cross-checked at the epoch
+        # boundary and a mismatch hard-fails naming the divergent host
+        intake.crosscheck_epoch_plan(loader, epoch)
         acc = MetricAccumulator()
         num_batches = len(loader)
         if start_batch:
@@ -404,7 +413,7 @@ class Trainer:
                 and (batch_idx + 1) % self.save_every_steps == 0
                 and batch_idx + 1 < num_batches  # epoch-end save follows
             ):
-                self._save_mid_epoch(epoch, batch_idx, metrics)
+                self._save_mid_epoch(loader, epoch, batch_idx, metrics)
             if self._preempt_requested:
                 # graceful preemption (SIGTERM): the in-flight step has
                 # finished — write `latest` with the cursor, drain the
@@ -421,7 +430,7 @@ class Trainer:
                 # (every rank saves at the same batch index) and exit
                 # cleanly here without an extra save.
                 if self.checkpoint_dir and jax.process_count() == 1:
-                    self._save_mid_epoch(epoch, batch_idx, metrics)
+                    self._save_mid_epoch(loader, epoch, batch_idx, metrics)
                     self._saver.wait()
                     logger.info(
                         "Preemption checkpoint complete (epoch %d, batch "
@@ -442,11 +451,15 @@ class Trainer:
 
     def _record_event(self, kind: str, **fields) -> None:
         """Recovery-event sink: counts per-surface firings and forwards to
-        graft-scope as a first-class record (telemetry/scope.py)."""
+        graft-scope as a first-class record (telemetry/scope.py). Events
+        fired before fit creates the scope (e.g. a shard quarantined while
+        init samples the first batch) buffer until it exists."""
         if kind == "checkpoint_fallback":
             self.recovery["checkpoint_fallbacks"] += 1
         if self.scope is not None:
             self.scope.record_event(kind, **fields)
+        elif len(self._pending_events) < 256:  # bounded: scope may never come
+            self._pending_events.append((kind, fields))
 
     def _drain_bad_steps(self) -> None:
         """Sum the bad-step flags accumulated since the last boundary (ONE
@@ -520,19 +533,27 @@ class Trainer:
             epoch=epoch,
         )
 
-    def _save_mid_epoch(self, epoch, batch_idx, metrics):
+    def _save_mid_epoch(self, loader, epoch, batch_idx, metrics):
         """Write `latest` stamped with the CURRENT epoch + loader cursor
-        (end-of-epoch saves stamp epoch+1 with no cursor)."""
+        (end-of-epoch saves stamp epoch+1, cursor 0)."""
+        extra = {
+            "best_accuracy": self._best_accuracy,
+            "batch_in_epoch": batch_idx + 1,
+        }
+        # graft-intake loader_manifest: the full input-plane cursor (epoch,
+        # global-batch step, sampler seed, quarantine set) — resume repeats
+        # no sample and skips none, even across an elastic reshape (the
+        # cursor is in GLOBAL batches, mesh-shape-agnostic)
+        man = intake.loader_manifest(loader, epoch, batch_idx + 1)
+        if man is not None:
+            extra[intake.LOADER_MANIFEST_KEY] = man
         with _span(self.scope, "checkpoint"):
             ckpt_lib.save_checkpoint(
                 os.path.join(self.checkpoint_dir, ckpt_lib.LATEST_NAME),
                 self.state,
                 epoch,
                 float(metrics["loss"]),
-                {
-                    "best_accuracy": self._best_accuracy,
-                    "batch_in_epoch": batch_idx + 1,
-                },
+                extra,
                 saver=self._saver,
                 sharded=self._sharded_ckpt(),
                 retain=self.checkpoint_retain,
@@ -564,6 +585,13 @@ class Trainer:
         epochs: int = 10,
         resume: Optional[str] = None,
     ) -> List[Dict[str, float]]:
+        if self._telemetry_cfg is not None:
+            # arm the input-plane event sink BEFORE anything touches the
+            # loader (init's sample batch below can already quarantine a
+            # corrupt shard); events fired before the scope exists are
+            # buffered by _record_event and flushed into it on creation
+            self._pending_events = []
+            intake.set_event_sink(self._record_event)
         if self.state is None:
             self.init(self._sample_inputs_from(train_loader))
 
@@ -607,6 +635,11 @@ class Trainer:
             for loader in (train_loader, val_loader):
                 if loader is not None and hasattr(loader, "telemetry"):
                     loader.telemetry = self.scope
+            # input-plane events that fired before the scope existed
+            # (sink armed at the top of fit) land in the event stream now
+            for kind, fields in self._pending_events:
+                self.scope.record_event(kind, **fields)
+            self._pending_events = []
 
         start_epoch = 0
         start_batch = 0
@@ -628,8 +661,17 @@ class Trainer:
             start_epoch = saved_epoch
             best_accuracy = float(extra.get("best_accuracy", 0.0))
             # mid-epoch checkpoints (save_every_steps) carry the loader
-            # cursor; resume restarts at that exact batch
-            start_batch = int(extra.get("batch_in_epoch", 0))
+            # cursor; resume restarts at that exact batch. graft-intake
+            # checkpoints stamp the full loader_manifest (seed + quarantine
+            # set, validated on restore); unstamped r12-era checkpoints
+            # keep today's bare batch_in_epoch behavior.
+            man = extra.get(intake.LOADER_MANIFEST_KEY)
+            if isinstance(man, dict):
+                start_batch = intake.restore_loader_state(
+                    train_loader, man, on_event=self._record_event,
+                )
+            else:
+                start_batch = int(extra.get("batch_in_epoch", 0))
             if start_batch >= len(train_loader):
                 start_epoch, start_batch = start_epoch + 1, 0
         dist.barrier("pre-train")
@@ -680,6 +722,7 @@ class Trainer:
                 signal.signal(signal.SIGINT, prev_int)
             # an exception mid-window must not leave a dangling active
             # jax trace, an unflushed metrics file, or a half-queued save
+            intake.set_event_sink(None)  # armed at the top of fit
             if self.scope is not None:
                 self.telemetry_summary = self.scope.close()
                 for loader in (train_loader, val_loader):
@@ -781,6 +824,12 @@ class Trainer:
                 self._best_accuracy = record["val_accuracy"]
             if self.checkpoint_dir:
                 extra = {"best_accuracy": self._best_accuracy}
+                # stamp the input-plane cursor at the NEXT epoch's start —
+                # resume re-derives epoch+1's plan plus today's quarantine
+                # set, so no quarantined sample sneaks back in after resume
+                man = intake.loader_manifest(train_loader, epoch + 1, 0)
+                if man is not None:
+                    extra[intake.LOADER_MANIFEST_KEY] = man
                 with _span(self.scope, "checkpoint"):
                     # epoch+1 so resume continues AFTER the finished epoch
                     if is_best:

@@ -52,6 +52,23 @@ matrix runs under ``-m slow``):
                         fleet run. Steady-state per-row decode cost with
                         the chaos checks armed (fault never firing) must
                         stay within 5% of a clean run.
+- ``corrupt-shard-midepoch`` * Input plane (graft-intake): a sealed
+                        image shard is bit-flipped on disk mid-epoch;
+                        the first touch fails its DPX-CRC1 sidecar,
+                        the shard is quarantined, its samples are
+                        deterministically remapped to intact shards,
+                        and the loss trajectory + final params are
+                        BIT-IDENTICAL to a control run that
+                        pre-quarantined the same shard (no corrupt
+                        sample is ever served). Steady-state epoch
+                        iteration with seal verification armed must
+                        stay within 5% of ``integrity="off"``.
+- ``kill-decode-worker`` * Input plane (graft-intake): the supervised
+                        prefetch worker crashes mid-epoch; the
+                        consumer-side supervisor restarts it at the
+                        exact batch the training loop expects next, so
+                        losses and final params are bit-identical to an
+                        uninjected run, with the restart in telemetry.
 
 Usage:
   python scripts/chaos_sweep.py [--fast] [--scenarios a,b,...]
@@ -74,6 +91,7 @@ if REPO_ROOT not in sys.path:
 FAST = (
     "nan-skip", "corrupt-latest", "io-flake", "rendezvous-flake",
     "kill-slice", "poison-request", "kill-replica-midstream",
+    "corrupt-shard-midepoch", "kill-decode-worker",
 )
 SLOW = (
     "inf-skip", "budget-rollback", "truncate-shard", "torn-save-kill",
@@ -695,6 +713,207 @@ def scenario_kill_replica_midstream() -> dict:
     return {"ok": ok, "action": "failover-replay", **detail}
 
 
+def _sealed_image_dir(td: str, tag: str, n=256, hw=4, shard_size=64) -> str:
+    """A sealed 4-shard image dataset, identical for every ``tag``."""
+    import numpy as np
+
+    from distributed_pytorch_example_tpu.data import streaming
+
+    root = os.path.join(td, tag)
+    os.makedirs(root)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, (n, hw, hw, 3)).astype(np.uint8)
+    w = rng.standard_normal((hw * hw * 3, 4)).astype(np.float32)
+    y = np.argmax(
+        (x.reshape(n, -1) / 255.0) @ w, axis=1
+    ).astype(np.int64)
+    streaming.write_image_shards(
+        root,
+        (
+            (x[lo:lo + shard_size], y[lo:lo + shard_size])
+            for lo in range(0, n, shard_size)
+        ),
+        shard_size=shard_size,
+        seal=True,
+    )
+    return root
+
+
+def scenario_corrupt_shard_midepoch() -> dict:
+    """Bit-flipped sealed shard mid-epoch (graft-intake): quarantine +
+    deterministic remap; trajectory bit-identical to a pre-quarantined
+    control because verify-before-serve means no corrupt sample is EVER
+    served — both runs serve the exact same remapped sample stream.
+    Armed seal verification must cost <= 5% on steady-state iteration."""
+    import tempfile
+
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.data import streaming
+    from distributed_pytorch_example_tpu.robustness import chaos
+
+    mesh = dpx.runtime.make_mesh()
+
+    def run(root, plan=None, pre_quarantine=None):
+        ds = streaming.StreamingImageShards(root)
+        if pre_quarantine:
+            ds.quarantine(pre_quarantine)
+        trainer = _make_trainer(mesh=mesh)
+        loader = dpx.data.DeviceLoader(ds, 64, mesh=mesh, seed=0)
+        if plan is not None:
+            chaos.install(plan)
+        try:
+            history = trainer.fit(loader, epochs=2)
+        finally:
+            if plan is not None:
+                chaos.uninstall()
+        return trainer, history, ds
+
+    def inject_plan():
+        return chaos.ChaosPlan(faults=[
+            chaos.Fault("corrupt-shard", path_substr="images_00002", nth=1)
+        ])
+
+    with tempfile.TemporaryDirectory() as td:
+        # separate dirs: the injected runs corrupt their shard ON DISK
+        ct, ch, _cds = run(
+            _sealed_image_dir(td, "control"), pre_quarantine={2}
+        )
+        t1, h1, ds1 = run(_sealed_image_dir(td, "hit1"), plan=inject_plan())
+        t2, _h2, _ds2 = run(
+            _sealed_image_dir(td, "hit2"), plan=inject_plan()
+        )
+
+        # steady-state overhead of seal verification: armed (sealed dir,
+        # integrity="quarantine" — the default) vs verification off, one
+        # epoch of prefetched iteration per sample, min over interleaved
+        # pair ratios with the collector parked (the min-ratio recipe the
+        # kill-replica-midstream gate pins; host noise only ADDS time)
+        import gc
+
+        bench_root = _sealed_image_dir(td, "bench", n=1024, shard_size=128)
+
+        def epoch_s(integrity):
+            ds = streaming.StreamingImageShards(
+                bench_root, integrity=integrity
+            )
+            loader = dpx.data.DeviceLoader(
+                ds, 64, mesh=mesh, seed=0, shuffle=False
+            )
+            t0 = time.perf_counter()
+            for _ in loader:
+                pass
+            return time.perf_counter() - t0
+
+        epoch_s("off")  # warm the h2d path before the first timed pair
+        gc.collect()
+        gc.disable()
+        try:
+            pairs = []
+            for _ in range(5):
+                clean_s = epoch_s("off")
+                armed_s = epoch_s("quarantine")
+                pairs.append((clean_s, armed_s))
+        finally:
+            gc.enable()
+    clean_s, armed_s = min(pairs, key=lambda p: p[1] / p[0])
+    ratio = armed_s / clean_s
+
+    events = [
+        e for e in (t1.telemetry_summary or {}).get("events", [])
+        if e.get("event") == "shard_quarantine"
+    ]
+    max_loss_diff = max(
+        abs(a["train_loss"] - b["train_loss"]) for a, b in zip(ch, h1)
+    )
+    digests = (_param_digest(ct.state), _param_digest(t1.state),
+               _param_digest(t2.state))
+    detail = {
+        "quarantined": sorted(ds1.quarantined_shards),
+        "quarantine_events": len(events),
+        "max_loss_diff_vs_prequarantined_control": max_loss_diff,
+        "params_match_control": digests[1] == digests[0],
+        "deterministic": digests[1] == digests[2],
+        "steady_state_ratio": round(ratio, 4),
+    }
+    return {
+        "ok": (
+            detail["quarantined"] == [2]
+            and detail["quarantine_events"] >= 1
+            and max_loss_diff == 0.0
+            and detail["params_match_control"]
+            and detail["deterministic"]
+            and ratio <= 1.05
+        ),
+        "action": "quarantine-and-remap",
+        **detail,
+    }
+
+
+def scenario_kill_decode_worker() -> dict:
+    """Prefetch-worker crash mid-epoch (graft-intake): the consumer-side
+    supervisor restarts the worker at the exact batch the training loop
+    expects next (batch assembly is a pure function of the index), so
+    the trajectory is bit-identical to an uninjected run."""
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.robustness import chaos
+
+    mesh = dpx.runtime.make_mesh()
+
+    def run(plan=None):
+        trainer = _make_trainer(mesh=mesh)
+        loader = dpx.data.DeviceLoader(_dataset(), 64, mesh=mesh, seed=0)
+        # init BEFORE arming the plan: fit's sample-batch iteration is
+        # abandoned after one batch, and whether its prefetch worker
+        # reaches the fault index first is a race — the epoch loop is
+        # where the kill must land, deterministically
+        trainer.init(next(iter(loader))["x"])
+        if plan is not None:
+            chaos.install(plan)
+        try:
+            history = trainer.fit(loader, epochs=2)
+        finally:
+            if plan is not None:
+                chaos.uninstall()
+        return trainer, history, loader
+
+    def kill_plan():
+        return chaos.ChaosPlan(faults=[
+            chaos.Fault("kill-decode-worker", step=2)
+        ])
+
+    ct, ch, _cl = run()
+    t1, h1, l1 = run(kill_plan())
+    t2, _h2, _l2 = run(kill_plan())
+
+    events = [
+        e for e in (t1.telemetry_summary or {}).get("events", [])
+        if e.get("event") == "decode_worker_restart"
+    ]
+    max_loss_diff = max(
+        abs(a["train_loss"] - b["train_loss"]) for a, b in zip(ch, h1)
+    )
+    digests = (_param_digest(ct.state), _param_digest(t1.state),
+               _param_digest(t2.state))
+    detail = {
+        "worker_restarts": l1.worker_restarts,
+        "restart_events": len(events),
+        "max_loss_diff_vs_uninjected": max_loss_diff,
+        "params_match_uninjected": digests[1] == digests[0],
+        "deterministic": digests[1] == digests[2],
+    }
+    return {
+        "ok": (
+            detail["worker_restarts"] >= 1
+            and detail["restart_events"] >= 1
+            and max_loss_diff == 0.0
+            and detail["params_match_uninjected"]
+            and detail["deterministic"]
+        ),
+        "action": "supervised-worker-restart",
+        **detail,
+    }
+
+
 SCENARIOS = {
     "nan-skip": lambda: scenario_poison_skip("nan-batch"),
     "inf-skip": lambda: scenario_poison_skip("inf-batch"),
@@ -708,6 +927,8 @@ SCENARIOS = {
     "kill-slice": scenario_kill_slice,
     "poison-request": scenario_poison_request,
     "kill-replica-midstream": scenario_kill_replica_midstream,
+    "corrupt-shard-midepoch": scenario_corrupt_shard_midepoch,
+    "kill-decode-worker": scenario_kill_decode_worker,
 }
 assert set(SCENARIOS) == set(ALL)
 

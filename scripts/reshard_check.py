@@ -5,6 +5,8 @@ Inspects one checkpoint — either format — WITHOUT building a mesh or
 touching devices, and prints ONE JSON line:
 
 - the format-3 ``mesh_manifest`` stamp (mesh axes, format, epoch);
+- the graft-intake ``loader_manifest`` stamp when present (input-plane
+  cursor, sampler seed, quarantined-shard set) — what resume will re-arm;
 - per-artifact seal status (gathered payload / manifest + every shard
   file): ``sealed`` (carries the CRC envelope) and ``intact`` (envelope
   verifies);
@@ -41,6 +43,7 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 from flax import serialization  # noqa: E402
 
+from distributed_pytorch_example_tpu.data import intake  # noqa: E402
 from distributed_pytorch_example_tpu.robustness import elastic  # noqa: E402
 from distributed_pytorch_example_tpu.robustness.integrity import (  # noqa: E402
     is_sealed,
@@ -108,6 +111,7 @@ def inspect_checkpoint(path: str, target: dict | None) -> dict:
         "format": None,
         "ok": False,
         "manifest": None,
+        "loader_manifest": None,
         "artifacts": [],
         "target": target or None,
         "resumable": None,
@@ -163,6 +167,24 @@ def inspect_checkpoint(path: str, target: dict | None) -> dict:
             "epoch": int(blob.get("epoch", -1)),
             "version": version,
         }
+        # graft-intake loader_manifest (rides in the checkpoint's extra
+        # dict): the exact input-plane cursor and quarantine set resume
+        # will re-arm — unstamped (pre-intake) checkpoints report null
+        extra = blob.get("extra")
+        lman = (
+            extra.get(intake.LOADER_MANIFEST_KEY)
+            if isinstance(extra, dict) else None
+        )
+        if isinstance(lman, dict):
+            report["loader_manifest"] = {
+                "epoch": int(lman.get("epoch", -1)),
+                "batch_in_epoch": int(lman.get("batch_in_epoch", 0)),
+                "seed": lman.get("seed"),
+                "quarantine": sorted(
+                    int(s) for s in lman.get("quarantine", ())
+                ),
+                "quarantine_digest": lman.get("quarantine_digest"),
+            }
 
     if target:
         if stamp is None:

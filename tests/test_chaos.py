@@ -542,6 +542,7 @@ def test_chaos_sweep_fast_subset_green():
     assert [r["scenario"] for r in lines] == [
         "nan-skip", "corrupt-latest", "io-flake", "rendezvous-flake",
         "kill-slice", "poison-request", "kill-replica-midstream",
+        "corrupt-shard-midepoch", "kill-decode-worker",
     ]
     assert all(r["ok"] for r in lines), lines
     by_name = {r["scenario"]: r for r in lines}
@@ -556,6 +557,17 @@ def test_chaos_sweep_fast_subset_green():
     assert fleet["greedy"]["bit_identical_to_clean"] is True
     assert fleet["seeded-topk"]["replay_token_exact"] is True
     assert fleet["steady_state_ratio"] <= 1.05
+    shard = by_name["corrupt-shard-midepoch"]
+    assert shard["action"] == "quarantine-and-remap"
+    assert shard["quarantined"] == [2]
+    assert shard["max_loss_diff_vs_prequarantined_control"] == 0.0
+    assert shard["params_match_control"] is True
+    assert shard["steady_state_ratio"] <= 1.05
+    decode = by_name["kill-decode-worker"]
+    assert decode["action"] == "supervised-worker-restart"
+    assert decode["worker_restarts"] >= 1
+    assert decode["max_loss_diff_vs_uninjected"] == 0.0
+    assert decode["params_match_uninjected"] is True
 
 
 @pytest.mark.slow

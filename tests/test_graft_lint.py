@@ -363,12 +363,40 @@ def test_fleet_unbounded_wait_scope_and_suppression():
         "def pump(inbox):\n"
         "    return inbox.get()\n"
     )
-    # only serving/ is in scope: a training-side queue may block forever
+    # scope is serving/ + data/ (the supervised thread paths): a
+    # training-side queue may still block forever
     assert pylint_rules.lint_source("train/loop.py", src) == []
     supp = src.replace(
         "inbox.get()", "inbox.get()  # graft-lint: fleet-unbounded-wait"
     )
     assert pylint_rules.lint_source("serving/fleet.py", supp) == []
+
+
+@pytest.mark.lint
+def test_fleet_unbounded_wait_covers_data_scope():
+    # graft-intake extended the rule to data/: a prefetch-path wait
+    # without a timeout can hang a training step on a dead decode worker
+    src = (
+        "def pump(q, worker):\n"
+        "    item = q.get()\n"
+        "    worker.join()\n"
+        "    return item\n"
+    )
+    findings = pylint_rules.lint_source("data/intake.py", src)
+    assert _rules(findings) == ["fleet-unbounded-wait"] * 2
+    bounded = (
+        "def pump(q, worker):\n"
+        "    item = q.get(timeout=0.2)\n"
+        "    worker.join(timeout=5.0)\n"
+        "    return item\n"
+    )
+    assert pylint_rules.lint_source("data/loader.py", bounded) == []
+    supp = src.replace(
+        "q.get()", "q.get()  # graft-lint: fleet-unbounded-wait"
+    ).replace(
+        "worker.join()", "worker.join()  # graft-lint: fleet-unbounded-wait"
+    )
+    assert pylint_rules.lint_source("data/intake.py", supp) == []
 
 
 @pytest.mark.lint
@@ -382,6 +410,19 @@ def test_fleet_real_modules_lint_clean():
         with open(path) as fh:
             src = fh.read()
         assert pylint_rules.lint_source(f"serving/{mod}", src) == [], mod
+
+
+@pytest.mark.lint
+def test_data_real_modules_lint_clean():
+    # the acceptance gate for the data/ extension: the shipped input
+    # plane carries a timeout on every blocking wait, as committed
+    for mod in ("intake.py", "loader.py", "streaming.py", "text.py"):
+        path = os.path.join(
+            REPO_ROOT, "distributed_pytorch_example_tpu", "data", mod,
+        )
+        with open(path) as fh:
+            src = fh.read()
+        assert pylint_rules.lint_source(f"data/{mod}", src) == [], mod
 
 
 @pytest.mark.lint

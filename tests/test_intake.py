@@ -1,0 +1,429 @@
+"""graft-intake: sealed shards, quarantine remap, supervised prefetch
+workers, loader-state resume, and the multi-host epoch-plan crosscheck."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_pytorch_example_tpu.data import intake
+from distributed_pytorch_example_tpu.data.streaming import (
+    StreamingImageShards,
+    write_image_shards,
+)
+from distributed_pytorch_example_tpu.data.text import (
+    load_token_file,
+    write_token_file,
+)
+from distributed_pytorch_example_tpu.robustness import chaos
+
+
+# ---------------------------------------------------------------------------
+# sealed files
+# ---------------------------------------------------------------------------
+
+
+def _write_blob(tmp_path, name="blob.npy", n=512):
+    path = str(tmp_path / name)
+    np.save(path, np.arange(n, dtype=np.int64))
+    return path
+
+
+def test_seal_verify_roundtrip(tmp_path):
+    path = _write_blob(tmp_path)
+    assert intake.verify_file(path) is None  # legacy: no sidecar
+    side = intake.seal_file(path)
+    assert os.path.exists(side) and side == path + intake.SIDECAR_SUFFIX
+    assert intake.verify_file(path) is True
+
+
+@pytest.mark.parametrize("mode", ["bitflip", "truncate"])
+def test_verify_catches_payload_damage(tmp_path, mode):
+    path = _write_blob(tmp_path)
+    intake.seal_file(path)
+    chaos.corrupt_file(path, mode=mode, seed=7)
+    assert intake.verify_file(path) is False
+
+
+def test_verify_catches_torn_sidecar(tmp_path):
+    path = _write_blob(tmp_path)
+    side = intake.seal_file(path)
+    chaos.corrupt_file(side, mode="truncate")
+    assert intake.verify_file(path) is False
+
+
+# ---------------------------------------------------------------------------
+# quarantine digest + remap
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_digest_order_independent_and_dedups():
+    assert intake.quarantine_digest([]) == 0
+    a = intake.quarantine_digest([3, 1, 7])
+    assert a == intake.quarantine_digest([7, 3, 1])
+    assert a == intake.quarantine_digest([1, 1, 3, 7, 7])
+    assert a != intake.quarantine_digest([1, 3])
+
+
+def test_remap_is_deterministic_and_lands_in_pool():
+    indices = np.arange(64, dtype=np.int64)
+    bad = (indices >= 16) & (indices < 32)
+    pool = np.concatenate([np.arange(16), np.arange(32, 64)])
+    salt = intake.quarantine_digest([1])
+    out1 = intake.remap_indices(indices, bad, pool, salt)
+    out2 = intake.remap_indices(indices.copy(), bad.copy(), pool, salt)
+    np.testing.assert_array_equal(out1, out2)
+    # untouched samples stay put; remapped ones land in the intact pool
+    np.testing.assert_array_equal(out1[~bad], indices[~bad])
+    assert np.isin(out1[bad], pool).all()
+    # a different quarantine set draws a different replacement stream
+    out3 = intake.remap_indices(indices, bad, pool,
+                                intake.quarantine_digest([2]))
+    assert not np.array_equal(out1[bad], out3[bad])
+
+
+def test_remap_no_bad_mask_is_identity_and_empty_pool_raises():
+    indices = np.arange(8, dtype=np.int64)
+    none_bad = np.zeros(8, bool)
+    assert intake.remap_indices(indices, none_bad,
+                                np.empty(0, np.int64), 0) is indices
+    with pytest.raises(intake.ShardCorruptError, match="every shard"):
+        intake.remap_indices(indices, ~none_bad, np.empty(0, np.int64), 0)
+
+
+# ---------------------------------------------------------------------------
+# multi-host epoch plan
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_plan_digest_sensitivity():
+    base = intake.epoch_plan_digest(0, 1, [])
+    assert base == intake.epoch_plan_digest(0, 1, [])
+    assert base != intake.epoch_plan_digest(1, 1, [])
+    assert base != intake.epoch_plan_digest(0, 2, [])
+    assert base != intake.epoch_plan_digest(0, 1, [3])
+
+
+def test_check_plan_agreement_names_divergent_host():
+    d = intake.epoch_plan_digest(0, 1, [])
+    intake.check_plan_agreement(np.asarray([d, d, d, d], np.uint64), 1)
+    rogue = intake.epoch_plan_digest(0, 1, [5])
+    with pytest.raises(RuntimeError, match=r"host\(s\) \[2\]"):
+        intake.check_plan_agreement(
+            np.asarray([d, d, rogue, d], np.uint64), epoch=1
+        )
+
+
+def test_crosscheck_epoch_plan_single_process(tmp_path, devices):
+    """World size 1: returns the digest without any collective; the digest
+    folds in the dataset's live quarantine set."""
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+
+    root = str(tmp_path / "s")
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (64, 4, 4, 3)).astype(np.uint8)
+    labels = rng.integers(0, 5, 64).astype(np.int64)
+    write_image_shards(root, [(imgs, labels)], shard_size=16, seal=True)
+    ds = StreamingImageShards(root)
+    loader = DeviceLoader(ds, 16, shuffle=True, seed=3, prefetch=0,
+                          num_shards=1, shard_id=0)
+    d0 = intake.crosscheck_epoch_plan(loader, epoch=1)
+    assert d0 == intake.epoch_plan_digest(3, 1, [])
+    ds.quarantine([2], reason="test")
+    assert intake.crosscheck_epoch_plan(loader, epoch=1) == (
+        intake.epoch_plan_digest(3, 1, [2])
+    )
+
+
+# ---------------------------------------------------------------------------
+# supervised prefetch worker
+# ---------------------------------------------------------------------------
+
+
+def _drain(worker):
+    out = []
+    while True:
+        item = worker.next_batch()
+        if item is None:
+            return out
+        out.append(item)
+
+
+def test_prefetch_worker_exact_sequence_from_any_start():
+    w = intake.PrefetchWorker(lambda i: i * 10, start=3, stop=9, maxsize=2)
+    try:
+        assert _drain(w) == [30, 40, 50, 60, 70, 80]
+        assert w.next_batch() is None  # exhausted stays exhausted
+        assert w.restarts == 0
+    finally:
+        w.close()
+
+
+def test_prefetch_worker_restart_reproduces_exact_batch():
+    crashed = []
+
+    def make(i):
+        if i == 4 and not crashed:
+            crashed.append(i)
+            raise ValueError("decode exploded")
+        return ("batch", i)
+
+    w = intake.PrefetchWorker(make, start=0, stop=8, maxsize=2)
+    try:
+        got = _drain(w)
+        assert got == [("batch", i) for i in range(8)]  # no skip, no repeat
+        assert w.restarts == 1
+    finally:
+        w.close()
+
+
+def test_prefetch_worker_retries_transient_oserror_in_place():
+    flaked = []
+
+    def make(i):
+        if i == 2 and len(flaked) < 2:
+            flaked.append(i)
+            raise OSError("flaky NFS")
+        return i
+
+    w = intake.PrefetchWorker(make, start=0, stop=5, maxsize=2)
+    try:
+        assert _drain(w) == list(range(5))
+        assert w.io_retries == 2
+        assert w.restarts == 0  # healed in place, no restart consumed
+    finally:
+        w.close()
+
+
+def test_prefetch_worker_restart_budget_exhaustion_raises():
+    def make(i):
+        if i == 1:
+            raise ValueError("permanently broken batch")
+        return i
+
+    w = intake.PrefetchWorker(make, start=0, stop=4, maxsize=2,
+                              max_restarts=2)
+    try:
+        assert w.next_batch() == 0
+        with pytest.raises(ValueError, match="permanently broken"):
+            while w.next_batch() is not None:
+                pass
+        assert w.restarts > 2
+    finally:
+        w.close()
+
+
+def test_prefetch_worker_close_joins_thread_and_is_idempotent():
+    before = {t.name for t in threading.enumerate()}
+    w = intake.PrefetchWorker(lambda i: i, start=0, stop=1000, maxsize=1,
+                              name="leakcheck")
+    assert w.next_batch() == 0
+    w.close()
+    w.close()  # idempotent
+    leaked = [
+        t for t in threading.enumerate()
+        if t.name == "intake-leakcheck" and t.is_alive()
+        and t.name not in before
+    ]
+    assert not leaked, f"leaked prefetch threads: {leaked}"
+    assert w.next_batch() is None  # closed worker serves nothing
+
+
+# ---------------------------------------------------------------------------
+# chaos hooks
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_corrupt_shard_fires_on_nth_touch(tmp_path):
+    path = _write_blob(tmp_path, "images_00001.npy")
+    intake.seal_file(path)
+    chaos.install(chaos.ChaosPlan(
+        [chaos.Fault("corrupt-shard", path_substr="images_00001", nth=2)]
+    ))
+    try:
+        chaos.shard_read(path)
+        assert intake.verify_file(path) is True  # first touch: intact
+        chaos.shard_read(path)
+        assert intake.verify_file(path) is False  # nth touch flipped a bit
+        chaos.shard_read(str(tmp_path / "images_00009.npy"))  # no match: noop
+    finally:
+        chaos.uninstall()
+
+
+def test_chaos_kill_decode_worker_fires_once():
+    chaos.install(chaos.ChaosPlan(
+        [chaos.Fault("kill-decode-worker", step=2)]
+    ))
+    try:
+        chaos.decode_worker(0)
+        chaos.decode_worker(1)
+        with pytest.raises(RuntimeError, match="decode worker killed"):
+            chaos.decode_worker(2)
+        chaos.decode_worker(2)  # one-shot: restart replays clean
+    finally:
+        chaos.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# streaming integrity modes
+# ---------------------------------------------------------------------------
+
+
+def _sealed_shards(tmp_path, name="shards", n=128, shard_size=32):
+    root = str(tmp_path / name)
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (n, 4, 4, 3)).astype(np.uint8)
+    labels = rng.integers(0, 7, n).astype(np.int64)
+    nshards = write_image_shards(
+        root, [(imgs, labels)], shard_size=shard_size, seal=True
+    )
+    return root, imgs, labels, nshards
+
+
+def test_streaming_writer_seals_every_file(tmp_path):
+    root, _, _, nshards = _sealed_shards(tmp_path)
+    assert nshards == 4
+    for f in sorted(os.listdir(root)):
+        if f.endswith(".npy"):
+            assert intake.verify_file(os.path.join(root, f)) is True
+
+
+def test_streaming_quarantines_corrupt_shard_and_remaps(tmp_path):
+    root, _, _, _ = _sealed_shards(tmp_path)
+    chaos.corrupt_file(os.path.join(root, "images_00002.npy"))
+    events = []
+    intake.set_event_sink(lambda kind, **f: events.append((kind, f)))
+    try:
+        ds = StreamingImageShards(root)
+        batch = ds.get_batch(np.arange(64, 96))  # exactly shard 2
+        assert ds.quarantined_shards == {2}
+        # every served sample was remapped off the quarantined shard
+        assert batch["x"].shape == (32, 4, 4, 3)
+        kinds = [k for k, _ in events]
+        assert "shard_quarantine" in kinds
+    finally:
+        intake.set_event_sink(None)
+    # detected-on-touch == pre-armed control: same remapped batches
+    control = StreamingImageShards(root)
+    control.quarantine([2], reason="control")
+    cb = control.get_batch(np.arange(64, 96))
+    np.testing.assert_array_equal(batch["x"], cb["x"])
+    np.testing.assert_array_equal(batch["y"], cb["y"])
+
+
+def test_streaming_strict_mode_raises(tmp_path):
+    root, _, _, _ = _sealed_shards(tmp_path, "strict")
+    chaos.corrupt_file(os.path.join(root, "images_00001.npy"))
+    ds = StreamingImageShards(root, integrity="strict")
+    with pytest.raises(intake.ShardCorruptError, match="images_00001"):
+        ds.get_batch(np.arange(32, 64))
+
+
+def test_streaming_integrity_off_skips_verification(tmp_path):
+    root, _, _, _ = _sealed_shards(tmp_path, "off")
+    chaos.corrupt_file(os.path.join(root, "images_00000.npy"))
+    ds = StreamingImageShards(root, integrity="off")
+    ds.get_batch(np.arange(0, 32))  # corrupt bytes served unchecked
+    assert ds.quarantined_shards == set()
+
+
+def test_streaming_corrupt_label_shard_quarantined_eagerly(tmp_path):
+    root, _, _, _ = _sealed_shards(tmp_path, "labels")
+    chaos.corrupt_file(os.path.join(root, "labels_00003.npy"))
+    ds = StreamingImageShards(root)
+    assert ds.quarantined_shards == {3}  # caught at open, pre-np.load
+    batch = ds.get_batch(np.arange(96, 128))  # shard 3's index range
+    assert np.isin(batch["y"], np.arange(7)).all()
+
+
+def test_streaming_quarantine_rejects_out_of_range(tmp_path):
+    root, _, _, _ = _sealed_shards(tmp_path, "range")
+    ds = StreamingImageShards(root)
+    with pytest.raises(ValueError, match="out of range"):
+        ds.quarantine([99])
+
+
+def test_streaming_bad_integrity_mode_rejected(tmp_path):
+    root, _, _, _ = _sealed_shards(tmp_path, "mode")
+    with pytest.raises(ValueError, match="integrity"):
+        StreamingImageShards(root, integrity="yolo")
+
+
+# ---------------------------------------------------------------------------
+# token files
+# ---------------------------------------------------------------------------
+
+
+def test_token_file_seal_and_verify(tmp_path):
+    path = str(tmp_path / "corpus.bin")
+    ids = np.arange(4096, dtype=np.uint16)
+    write_token_file(path, ids)  # seal=True default
+    ds = load_token_file(path, seq_len=64)
+    assert len(ds) == 64
+    chaos.corrupt_file(path)
+    with pytest.raises(intake.ShardCorruptError, match="sidecar"):
+        load_token_file(path, seq_len=64)
+    # verify=False: explicit opt-out still loads
+    assert len(load_token_file(path, seq_len=64, verify=False)) == 64
+
+
+# ---------------------------------------------------------------------------
+# loader-state resume
+# ---------------------------------------------------------------------------
+
+
+def test_loader_manifest_and_restore_roundtrip(tmp_path, devices):
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+
+    root, _, _, _ = _sealed_shards(tmp_path, "resume")
+    ds = StreamingImageShards(root)
+    ds.quarantine([1], reason="test")
+    loader = DeviceLoader(ds, 16, shuffle=True, seed=11, prefetch=0,
+                          num_shards=1, shard_id=0)
+    man = intake.loader_manifest(loader, epoch=2, batch_in_epoch=5)
+    assert man == {
+        "format": intake.LOADER_MANIFEST_FORMAT,
+        "epoch": 2,
+        "batch_in_epoch": 5,
+        "seed": 11,
+        "shuffle": True,
+        "quarantine": [1],
+        "quarantine_digest": intake.quarantine_digest([1]),
+    }
+
+    fresh_ds = StreamingImageShards(root)
+    fresh = DeviceLoader(fresh_ds, 16, shuffle=True, seed=11, prefetch=0,
+                         num_shards=1, shard_id=0)
+    events = []
+    cursor = intake.restore_loader_state(
+        fresh, man, on_event=lambda k, **f: events.append((k, f))
+    )
+    assert cursor == 5
+    assert fresh_ds.quarantined_shards == {1}  # re-armed pre-first-batch
+    assert events and events[0][0] == "loader_quarantine_restored"
+
+
+def test_restore_loader_state_seed_mismatch_hard_fails(tmp_path, devices):
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+    from distributed_pytorch_example_tpu.data.synthetic import _ArrayDataset
+
+    ds = _ArrayDataset({
+        "x": np.zeros((64, 4), np.float32),
+        "y": np.zeros(64, np.int32),
+    })
+    loader = DeviceLoader(ds, 16, seed=0, prefetch=0,
+                          num_shards=1, shard_id=0)
+    man = {"format": 1, "epoch": 0, "batch_in_epoch": 2, "seed": 999,
+           "quarantine": []}
+    with pytest.raises(ValueError, match="seed 999"):
+        intake.restore_loader_state(loader, man)
+
+
+def test_loader_manifest_none_without_sampler():
+    class Bare:
+        pass
+
+    assert intake.loader_manifest(Bare(), 0, 0) is None
+    with pytest.raises(ValueError, match="no sampler"):
+        intake.restore_loader_state(Bare(), {"seed": 0})

@@ -91,3 +91,31 @@ def test_tuple_dataset_convention(mesh_1d):
     b = next(iter(loader))
     assert b["x"].shape == (8, 4)
     assert np.asarray(b["y"]).tolist() == [0, 1, 2, 0, 1, 2, 0, 1]
+
+
+def test_abandoned_iteration_joins_prefetch_thread(mesh_1d):
+    """Abandoning a prefetching iterator mid-epoch (GeneratorExit — e.g.
+    a bad-step rollback unwinding the epoch loop) must stop, drain, and
+    JOIN the supervised worker — the old fire-and-forget thread stayed
+    parked on a full queue forever, leaking one thread per abandonment."""
+    import gc
+    import threading
+
+    from distributed_pytorch_example_tpu.data.synthetic import (
+        SyntheticClassificationDataset,
+    )
+
+    ds = SyntheticClassificationDataset(num_samples=512)
+    for _ in range(5):  # one leak per abandonment would accumulate here
+        loader = DeviceLoader(ds, 8, mesh=mesh_1d, prefetch=2)
+        it = iter(loader)
+        next(it)  # worker is live and its queue fills behind the consumer
+        it.close()  # deliver GeneratorExit to iter_from's finally
+    gc.collect()
+    leaked = [
+        t for t in threading.enumerate()
+        if t.name.startswith("intake-") and t.is_alive()
+    ]
+    assert not leaked, f"abandoned iterations leaked threads: {leaked}"
+    # the close path still accumulated the iteration's counters
+    assert loader.batches_served == 1

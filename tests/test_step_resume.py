@@ -212,3 +212,152 @@ def test_iter_from_matches_tail_of_full_iteration(devices):
             np.testing.assert_array_equal(a[k], b[k])
     with pytest.raises(ValueError, match="start_step"):
         list(loader.iter_from(len(loader) + 1))
+
+
+# ---------------------------------------------------------------------------
+# graft-intake mid-epoch resume matrix: exact global sample sequence —
+# no repeat, no skip — across prefetch, quarantine, and elastic reshape
+# ---------------------------------------------------------------------------
+
+
+class _RecordingDataset:
+    """Map-style dataset whose batches ARE the served sample indices, so a
+    test can read the exact global sample sequence off the batch stream."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def get_batch(self, indices):
+        idx = np.asarray(indices, np.int64)
+        return {
+            "x": idx.astype(np.float32).reshape(-1, 1),
+            "y": idx.astype(np.int32),
+        }
+
+
+def _served(batches):
+    """Per-step served global sample ids from a batch stream."""
+    return [np.sort(np.asarray(b["y"]).reshape(-1)) for b in batches]
+
+
+def test_resume_non_prefetch_aligned_start_with_prefetch(devices):
+    """iter_from at a cursor that is NOT a multiple of the prefetch depth
+    must still yield exactly the uninterrupted tail — the supervised
+    worker's start cursor is the consumer cursor, not a queue boundary."""
+    import threading
+
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+
+    ds = _RecordingDataset(64)
+    loader = DeviceLoader(ds, 8, num_shards=1, shard_id=0, seed=9,
+                          prefetch=3)
+    loader.set_epoch(4)
+    full = _served(iter(loader))
+    loader.set_epoch(4)
+    tail = _served(loader.iter_from(5))  # 5 % 3 != 0: mid-queue cursor
+    assert len(tail) == len(full) - 5
+    for a, b in zip(full[5:], tail):
+        np.testing.assert_array_equal(a, b)
+    # both iterations closed their supervised workers: no leaked threads
+    assert not [
+        t for t in threading.enumerate()
+        if t.name.startswith("intake-") and t.is_alive()
+    ]
+
+
+def test_resume_with_quarantined_shard_via_loader_manifest(tmp_path, devices):
+    """A checkpoint stamped after a quarantine must resume onto the SAME
+    remapped sample stream: restore re-arms the quarantine set before the
+    first batch, so the tail equals a control that trained with the shard
+    quarantined from the start."""
+    from distributed_pytorch_example_tpu.data import intake
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+    from distributed_pytorch_example_tpu.data.streaming import (
+        StreamingImageShards,
+        write_image_shards,
+    )
+
+    root = str(tmp_path / "shards")
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (128, 4, 4, 3)).astype(np.uint8)
+    labels = rng.integers(0, 9, 128).astype(np.int64)
+    write_image_shards(root, [(imgs, labels)], shard_size=32, seal=True)
+
+    def make_loader(quarantine):
+        ds = StreamingImageShards(root)
+        if quarantine:
+            ds.quarantine(quarantine, reason="test")
+        loader = DeviceLoader(ds, 16, shuffle=True, seed=3, prefetch=2,
+                              num_shards=1, shard_id=0)
+        loader.set_epoch(1)
+        return ds, loader
+
+    # control: shard 1 quarantined from the very start of the epoch
+    _, control = make_loader([1])
+    ctrl_batches = [
+        {k: np.asarray(v) for k, v in b.items()} for b in iter(control)
+    ]
+
+    # "crashed" run stamped a manifest at batch 5 with shard 1 quarantined
+    man_ds, man_loader = make_loader([1])
+    man = intake.loader_manifest(man_loader, epoch=1, batch_in_epoch=5)
+    assert man["quarantine"] == [1]
+
+    # resume: FRESH dataset (no quarantine knowledge) + manifest restore
+    fresh_ds, fresh = make_loader([])
+    cursor = intake.restore_loader_state(fresh, man)
+    assert cursor == 5 and fresh_ds.quarantined_shards == {1}
+    for got, want in zip(fresh.iter_from(cursor), ctrl_batches[5:]):
+        for k in want:
+            np.testing.assert_array_equal(np.asarray(got[k]), want[k])
+
+
+def test_elastic_dp8_to_dp4_resume_exact_global_sequence(devices):
+    """Kill a dp8 run mid-epoch, resume on dp4: the combined pre-kill and
+    post-resume global batches must serve every sample EXACTLY once, in
+    the same per-step global order an uninterrupted run serves — the
+    loader_manifest cursor is in global-batch steps, so it transfers
+    across the reshape unchanged."""
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+
+    n, gbs, seed, epoch, cut = 128, 16, 7, 2, 3
+    ds = _RecordingDataset(n)
+
+    def shard_loaders(num_shards):
+        loaders = []
+        for sid in range(num_shards):
+            ld = DeviceLoader(ds, gbs, num_shards=num_shards, shard_id=sid,
+                              seed=seed, prefetch=2)
+            ld.set_epoch(epoch)
+            loaders.append(ld)
+        return loaders
+
+    # uninterrupted single-process control: per-step global sample sets
+    control = shard_loaders(1)[0]
+    ctrl = _served(iter(control))
+    assert len(ctrl) == n // gbs
+
+    # dp8 "run" serves global steps [0, cut); the kill lands there
+    pre = [_served(ld.iter_from(0)) for ld in shard_loaders(8)]
+    # dp4 resume serves global steps [cut, end) from the stamped cursor
+    post = [_served(ld.iter_from(cut)) for ld in shard_loaders(4)]
+
+    served = []
+    for step in range(cut):
+        served.append(np.sort(np.concatenate(
+            [pre[sid][step] for sid in range(8)]
+        )))
+    for step in range(len(ctrl) - cut):
+        served.append(np.sort(np.concatenate(
+            [post[sid][step] for sid in range(4)]
+        )))
+
+    # same per-step global batch as the uninterrupted control...
+    for step, (got, want) in enumerate(zip(served, ctrl)):
+        np.testing.assert_array_equal(got, want, err_msg=f"step {step}")
+    # ...and the epoch as a whole repeats no sample and skips none
+    all_served = np.sort(np.concatenate(served))
+    np.testing.assert_array_equal(all_served, np.arange(n))
