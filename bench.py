@@ -351,6 +351,10 @@ def run_model(name: str, args) -> dict:
         partitioner = dpx.parallel.data_parallel(
             mesh, dp_shard_opt_state=args.zero1
         )
+    # graft-wire: compress the gradient collectives (parallel/wire.py)
+    partitioner.wire = dpx.parallel.WireConfig(
+        compress=args.wire, block_size=args.wire_block
+    )
     global_batch = batch_per_chip * n_chips
     if batch_per_chip % args.grad_accum:
         raise ValueError(
@@ -525,6 +529,17 @@ def run_model(name: str, args) -> dict:
         "vs_baseline": round(rate / baseline, 3),
         "opt_state_bytes_per_chip": opt_bytes,
         "step_time_ms": round(step_time_ms, 3),
+        # graft-wire analytic accounting (parallel/wire.py
+        # grad_wire_report): per-device gradient-sync payload bytes per
+        # step and the fp32/compressed ratio (1.0 when --wire none)
+        "grad_wire_bytes_per_step": (
+            trainer.wire_report["grad_wire_bytes_per_step"]
+            if trainer.wire_report else None
+        ),
+        "wire_compression_ratio": (
+            trainer.wire_report["wire_compression_ratio"]
+            if trainer.wire_report else None
+        ),
         # compiler-reported HBM residency of the step (args+out+temps−alias;
         # telemetry/cost.py) — None when the backend can't answer
         "hbm_peak_bytes": cost["hbm_peak_bytes"],
@@ -538,6 +553,11 @@ def run_model(name: str, args) -> dict:
             "warmup": args.warmup,
             "grad_accum": args.grad_accum,
             "zero1": args.zero1,
+            **(
+                {"wire": args.wire, "wire_block": args.wire_block}
+                if args.wire != "none"
+                else {}
+            ),
             **(
                 {"flash": args.flash, "remat": args.remat}
                 if flags_apply
@@ -621,6 +641,14 @@ def main():
     parser.add_argument("--grad-accum", type=int, default=1,
                         help="microbatches accumulated inside the step "
                         "before ONE gradient collective (train/step.py)")
+    parser.add_argument("--wire", default="none",
+                        choices=("none", "int8-block"),
+                        help="graft-wire gradient-collective compression "
+                        "(int8 payloads + per-block bf16 scales; "
+                        "parallel/wire.py)")
+    parser.add_argument("--wire-block", type=int, default=256,
+                        help="elements per bf16 scale block for "
+                        "--wire int8-block")
     parser.add_argument("--zero1", action="store_true",
                         help="ZeRO-1: reduce-scatter grads, shard the "
                         "optimizer state over data, all-gather params")

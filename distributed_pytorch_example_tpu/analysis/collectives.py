@@ -21,6 +21,15 @@ Byte volume is the collective's RESULT buffer size — a deliberate,
 consistent proxy (for all-gather it is the gathered size, for
 reduce-scatter the scattered size); the gate cares about deltas, not an
 exact wire-byte model. ``-start``/``-done`` async pairs count once.
+
+graft-wire makes the machinery compression-aware: ``parse_collective_
+dtypes`` breaks the same proxy down per payload dtype, and wire-
+compressed configs carry a ``wire-int8-step`` signature whose gate
+requires an ``s8`` collective payload plus the analytic >=3x ratio from
+``parallel/wire.py grad_wire_report`` (the result-buffer proxy alone
+cannot express the wire win: an int8 all-to-all's RESULT is n bytes
+while a tiled fp32 reduce-scatter's is n/D*4 — larger, though the wire
+moves ~4x less).
 """
 
 from __future__ import annotations
@@ -98,6 +107,39 @@ def parse_collectives(hlo_text: str) -> Dict[str, Dict[str, int]]:
     return out
 
 
+def parse_collective_dtypes(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """``{kind: {dtype: bytes}}`` — the collective mix broken down by
+    payload dtype. This is what makes the budget machinery
+    compression-aware: a wire-compressed config must show its gradient
+    bytes moving as ``s8`` (+ ``bf16`` scales); an all-f32 breakdown on
+    such a config is the silent-fallback failure the ``wire-int8-step``
+    signature gates on. Same result-buffer byte proxy as
+    ``parse_collectives``; ``-start``/``-done`` pairs count once.
+    """
+    out: Dict[str, Dict[str, int]] = {}
+    for line in hlo_text.splitlines():
+        m = _HLO_OP_RE.match(line)
+        if m is None:
+            continue
+        shape_str, op = m.groups()
+        if op.endswith("-done"):
+            continue
+        if op.endswith("-start"):
+            op = op[: -len("-start")]
+        if op not in COLLECTIVE_KINDS:
+            continue
+        rec = out.setdefault(op, {})
+        for dtype, dims in _SHAPE_RE.findall(shape_str):
+            if dtype not in _DTYPE_BYTES:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            rec[dtype] = rec.get(dtype, 0) + n * _DTYPE_BYTES[dtype]
+    return out
+
+
 # Schedule-implementation markers: jax.named_scope names that the 1F1B
 # backward modes stamp into op metadata (parallel/pipeline.py). They
 # survive into the compiled module's text, so the budget file can pin a
@@ -133,12 +175,22 @@ def collective_record(case, compiled) -> Dict[str, object]:
         "global_batch": int(case.global_batch),
         "collectives": parse_collectives(text),
     }
-    if "zero1" in case.name.split("+"):
+    parts = case.name.split("+")
+    if "zero1" in parts:
         # structural contract, stronger than count/byte deltas: the gate
         # additionally requires RS+AG to be PRESENT (see compare_budgets)
         record["signature"] = "zero1-dp-step"
+    if "wire-int8" in parts:
+        # wire compression replaces the zero1 signature (the quantized
+        # reduce-scatter compiles to all-to-all, so RS-presence would
+        # fail by design): the gate instead requires an s8 collective
+        # payload + the analytic >=3x wire ratio (see compare_budgets)
+        record["signature"] = "wire-int8-step"
+        record["dtypes"] = parse_collective_dtypes(text)
+        if getattr(case.trainer, "wire_report", None):
+            record["wire"] = dict(case.trainer.wire_report)
     markers = parse_markers(text)
-    if "stash1f1b" in case.name.split("+"):
+    if "stash1f1b" in parts:
         # pin the no-recompute config to its stash marker: a silent
         # fallback to the replay backward stays under every byte budget
         # (it REMOVES nothing) and only the signature can catch it
@@ -155,6 +207,8 @@ def compare_budgets(
     config: Optional[str] = None,
     signature: Optional[str] = None,
     markers: Optional[Dict[str, bool]] = None,
+    dtypes: Optional[Dict[str, Dict[str, int]]] = None,
+    wire: Optional[Dict[str, object]] = None,
 ) -> Tuple[List[Finding], List[str]]:
     """(violations, notes) of a measured collective set vs its budget.
 
@@ -175,9 +229,60 @@ def compare_budgets(
     and must NOT carry ``1f1b_recompute_apply`` (``markers`` — see
     ``parse_markers``). A silent fallback to the replay backward changes
     no collective counts at all, so only this marker check can catch it.
+    ``"wire-int8-step"`` (a wire-compressed config, parallel/wire.py):
+    the compiled HLO must move gradient bytes as int8 — some collective
+    payload in ``dtypes`` must be ``s8`` — and ``wire`` (the analytic
+    ``grad_wire_report``) must show the >=3x compression ratio, with the
+    ZeRO-1 re-replication all-gather still present. A config that
+    silently falls back to fp32 payloads (WireConfig lost between the
+    partitioner and the step, or every leaf under ``min_size``) changes
+    nothing a count/byte ratchet can see — only this signature fails.
     """
     violations: List[Finding] = []
     notes: List[str] = []
+    if signature == "wire-int8-step":
+        s8_bytes = sum(
+            rec.get("s8", 0) for rec in (dtypes or {}).values()
+        )
+        if s8_bytes == 0:
+            violations.append(Finding(
+                rule="comm-wire-signature",
+                where="s8-payload",
+                message=(
+                    "wire-compressed config compiled with NO s8 "
+                    "collective payload: the gradient sync silently fell "
+                    "back to full-precision traffic (WireConfig not "
+                    "reaching train/step.py's sync dispatch, or "
+                    "compress='none' where 'int8-block' was committed)"
+                ),
+                config=config,
+            ))
+        if measured.get("all-gather", {}).get("count", 0) == 0:
+            violations.append(Finding(
+                rule="comm-wire-signature",
+                where="all-gather",
+                message=(
+                    "wire-compressed ZeRO-1 config compiled with NO "
+                    "all-gather: the param re-replication disappeared — "
+                    "the compression must shrink the gradient sync, not "
+                    "drop the weight-update gather"
+                ),
+                config=config,
+            ))
+        ratio = float((wire or {}).get("wire_compression_ratio", 0.0) or 0.0)
+        if ratio < 3.0:
+            violations.append(Finding(
+                rule="comm-wire-signature",
+                where="wire_compression_ratio",
+                message=(
+                    f"wire-compressed config reports grad-traffic "
+                    f"compression {ratio:.2f}x < 3x (parallel/wire.py "
+                    f"grad_wire_report): the int8-block payload must cut "
+                    f"gradient wire bytes at least 3x — check min_size / "
+                    f"block_size and the partitioner's WireConfig"
+                ),
+                config=config,
+            ))
     if signature == "1f1b-stash":
         mk = markers or {}
         if not mk.get("1f1b_stash_apply", False):

@@ -1,6 +1,6 @@
 """Repo-specific Python AST lints (no jax import, no backend).
 
-Nine rules, each a distilled past-regression class:
+Ten rules, each a distilled past-regression class:
 
 - ``host-sync``: ``.item()`` / ``np.asarray`` / ``jax.device_get`` inside
   TRACED-SCOPE sources (``ops/``, ``models/``, ``parallel/``,
@@ -74,6 +74,17 @@ Nine rules, each a distilled past-regression class:
   are all fine), and ``block=False`` non-blocking gets are fine;
   everything else must pass ``timeout=``.
 
+- ``wire-raw-collective``: a raw ``psum(...)`` / ``psum_scatter(...)``
+  call inside ``train/step.py``. graft-wire's contract is that EVERY
+  gradient collective in the step routes through ``parallel/wire.py``
+  (``wire_psum`` / ``wire_psum_scatter``), which honor the
+  ``WireConfig`` compression policy — a direct ``lax.psum*`` call added
+  to the step silently ships fp32 payloads regardless of
+  ``--wire int8-block``, exactly the fallback class the
+  ``wire-int8-step`` comm-budget signature exists to catch, but at the
+  source level before any compile. ``pmean`` (metrics averaging) and
+  the ``wire_*`` wrappers themselves are fine.
+
 Scope is static and name-based, not a whole-program call graph — the
 cheap 99% of the check. Deliberate exceptions carry a
 ``# graft-lint: ok`` (all rules) or ``# graft-lint: <rule>`` comment on
@@ -103,6 +114,10 @@ SERVE_SCOPE = ("serving/",)
 # prefetch queues) — a bare Queue.get()/Event.wait()/Thread.join() in
 # either can wedge a whole host on one dead peer/worker
 WAIT_SCOPE = ("serving/", "data/")
+# wire-raw-collective pins the step's gradient sync to the graft-wire
+# dispatch (parallel/wire.py) — a raw lax.psum*/psum_scatter in the step
+# bypasses the WireConfig compression policy
+WIRE_RAW_SCOPE = ("train/step.py",)
 
 _ACCUM_CTORS = ("zeros", "zeros_like", "full", "empty")
 
@@ -434,6 +449,7 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
     mesh_scope = _in_scope(relpath, MESH_GUESS_SCOPE)
     debug_scope = _in_scope(relpath, DEBUG_CALLBACK_SCOPE)
     nan_scope = _in_scope(relpath, NAN_LAUNDER_SCOPE)
+    wire_scope = _in_scope(relpath, WIRE_RAW_SCOPE)
 
     visitor = _FuncStack()
     sharding_aware: Dict[ast.AST, bool] = {}
@@ -517,6 +533,25 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
                         "and the bad-step predication; let detection + "
                         "update skipping (graft-armor) handle nonfinite "
                         "steps instead"
+                    ),
+                ))
+        if wire_scope:
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name in ("psum", "psum_scatter") and not _suppressed(
+                supp, node.lineno, "wire-raw-collective"
+            ):
+                findings.append(Finding(
+                    rule="wire-raw-collective",
+                    where=f"{relpath}:{node.lineno}",
+                    message=(
+                        f"raw {name}(...) in the train step bypasses the "
+                        "graft-wire dispatch: it always ships fp32 "
+                        "payloads, ignoring the WireConfig compression "
+                        "policy — route gradient collectives through "
+                        "parallel/wire.py (wire_psum / wire_psum_scatter)"
                     ),
                 ))
         if mesh_scope:

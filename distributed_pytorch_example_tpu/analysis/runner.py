@@ -308,6 +308,11 @@ def audit_configs(
                         "signature", record.get("signature")
                     ),
                     markers=record.get("markers"),
+                    # measured values, not the committed ones: the
+                    # wire-int8-step signature must fail when THIS
+                    # compile lost the s8 payload or the >=3x ratio
+                    dtypes=record.get("dtypes"),
+                    wire=record.get("wire"),
                 )
                 if skew is not None:
                     result.notes.extend(

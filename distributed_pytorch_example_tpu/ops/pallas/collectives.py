@@ -1,0 +1,308 @@
+"""Pallas async bidirectional-ring collectives for TPU.
+
+XLA already emits ring collectives, but it schedules them as opaque
+fusion barriers: the reduce-scatter for microbatch k cannot overlap the
+backward compute of microbatch k+1 inside the ``grad_accum_steps`` scan
+(train/step.py). These kernels rebuild all-gather and reduce-scatter out
+of explicit inter-chip DMAs (``pltpu.make_async_remote_copy`` — the
+SNIPPETS.md [1] / pallas-guide right-permute idiom) so the data movement
+is ordinary async copies the Mosaic scheduler can interleave with
+surrounding compute:
+
+- **Bidirectional ring**: the local payload splits in half; the low half
+  travels clockwise (to ``me+1``), the high half counter-clockwise, so
+  BOTH ICI directions carry bytes every hop and per-link traffic halves
+  versus a unidirectional ring at the same (D-1)/D * n total.
+- **Double buffering**: two semaphore/accumulator slots per direction,
+  alternating by hop, so hop h+1's DMA issues while hop h's completion
+  is still outstanding on the other slot — the wait for the next chunk
+  runs behind the reduce-add of the current one. This is the compute
+  overlap the wire layer buys inside the grad-accum scan.
+
+``lax.axis_index`` is safe HERE (unlike train/step.py's data-manual
+body): these kernels only lower on the TPU backend, where PartitionId
+exists; ``ring_supported()`` gates every caller, and the 8-device fake
+CPU mesh the tests run on always takes the XLA-collective fallback with
+identical numerics (tests/test_wire.py compares the two wherever the
+kernel lowers).
+
+Scope notes:
+
+- Int8 payloads (the wire-compressed gather halves, parallel/wire.py)
+  ride the ring fine — gathering moves bytes without arithmetic. The
+  quantized REDUCE cannot: int8 partial sums overflow and every hop
+  would need a requantize, so the compressed reduce-scatter stays on the
+  XLA all-to-all decomposition (see parallel/wire.py).
+- Neighbor addressing uses mesh coordinates along ``axis_name``
+  (``DeviceIdType.MESH``), i.e. the kernels assume they are shard_mapped
+  over a single mesh axis — the wire layer's gather call sites. Any
+  shape/backend the kernels do not cover falls back to the XLA
+  collective; ``WireConfig(ring="off")`` is the unconditional escape
+  hatch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pallas TPU lowering is present in the pinned jax; guard anyway
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover - import guard for stripped builds
+    _PALLAS_OK = False
+
+_LANES = 128  # VREG lane width: work buffers are shaped (rows, 128)
+
+
+def ring_supported() -> bool:
+    """True when the async ring kernels can lower on this backend."""
+    if not _PALLAS_OK:
+        return False
+    try:
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover - uninitialized backend
+        return False
+    return backend == "tpu" and len(jax.devices()) > 1
+
+
+def _axis_size(axis_name: str) -> int:
+    # concrete: psum of a python scalar folds to the static axis size
+    return int(lax.psum(1, axis_name))
+
+
+def _half_rows(n: int):
+    """Rows of the (rows, 128) half-payload buffer, or None if the local
+    payload cannot split into two lane-aligned halves."""
+    if n and n % (2 * _LANES) == 0:
+        return n // 2 // _LANES
+    return None
+
+
+# -- all-gather -------------------------------------------------------------
+
+
+def _ag_kernel(x_ref, out_ref, send_sems, recv_sems, *, axis_name,
+               num_devices):
+    """Bidirectional ring all-gather body.
+
+    ``x_ref``: (2, rows, 128) — the local shard's two direction-halves.
+    ``out_ref``: (D, 2, rows, 128) — slot d collects device d's shard.
+    Each device seeds its own slot, then on hop h forwards the chunk
+    that arrived h hops back: clockwise the low half of chunk (me - h),
+    counter-clockwise the high half of chunk (me + h). After D-1 hops
+    every slot is full. Semaphore slots alternate by hop (double
+    buffer); the two directions' DMAs are both in flight before either
+    is waited on, keeping both ICI directions busy.
+    """
+    me = lax.axis_index(axis_name)
+    right = lax.rem(me + 1, num_devices)
+    left = lax.rem(me - 1 + num_devices, num_devices)
+
+    # local barrier with both neighbors: nobody DMAs into a peer that
+    # has not entered the kernel yet (pallas guide, RDMA section)
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(
+        barrier, device_id=(right,),
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    pltpu.semaphore_signal(
+        barrier, device_id=(left,),
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    pltpu.semaphore_wait(barrier, 2)
+
+    # seed my own slot with my shard
+    seed = pltpu.make_async_copy(x_ref, out_ref.at[me], recv_sems.at[0, 0])
+    seed.start()
+    seed.wait()
+
+    for h in range(num_devices - 1):
+        slot = h % 2
+        c_cw = lax.rem(me - h + num_devices, num_devices)
+        c_ccw = lax.rem(me + h, num_devices)
+        cw = pltpu.make_async_remote_copy(
+            src_ref=out_ref.at[c_cw, 0],
+            dst_ref=out_ref.at[c_cw, 0],
+            send_sem=send_sems.at[0, slot],
+            recv_sem=recv_sems.at[0, slot],
+            device_id=(right,),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        ccw = pltpu.make_async_remote_copy(
+            src_ref=out_ref.at[c_ccw, 1],
+            dst_ref=out_ref.at[c_ccw, 1],
+            send_sem=send_sems.at[1, slot],
+            recv_sem=recv_sems.at[1, slot],
+            device_id=(left,),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        cw.start()
+        ccw.start()  # both directions in flight before either wait
+        cw.wait()
+        ccw.wait()
+
+
+def ring_all_gather(x, axis_name: str):
+    """Tiled axis-0 all-gather along ``axis_name`` via the async
+    bidirectional ring — the drop-in shape contract of
+    ``lax.all_gather(x, axis_name, axis=0, tiled=True)``. Call inside a
+    shard_map manual over ``axis_name``; any backend or payload shape
+    the kernel does not cover takes the identical-numerics XLA path.
+    """
+    d = _axis_size(axis_name)
+    rows = _half_rows(x.size)
+    if d == 1 or rows is None or not ring_supported():
+        return lax.all_gather(x, axis_name, axis=0, tiled=True)
+    halves = x.reshape(2, rows, _LANES)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2, 2)),  # send: [direction, slot]
+            pltpu.SemaphoreType.DMA((2, 2)),  # recv
+        ],
+    )
+    stacked = pl.pallas_call(
+        functools.partial(
+            _ag_kernel, axis_name=axis_name, num_devices=d
+        ),
+        out_shape=jax.ShapeDtypeStruct((d,) + halves.shape, x.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.TPUCompilerParams(collective_id=0),
+    )(halves)
+    return stacked.reshape((d * x.shape[0],) + x.shape[1:])
+
+
+# -- reduce-scatter ---------------------------------------------------------
+
+
+def _rs_kernel(parts_ref, out_ref, acc_ref, recv_ref, send_sems,
+               recv_sems, *, axis_name, num_devices):
+    """Bidirectional ring reduce-scatter body.
+
+    ``parts_ref``: (D, 2, rows, 128) f32, destination-major — chunk d is
+    bound for device d, split into two direction-halves. Classic ring
+    RS run twice at half payload: clockwise the partial for chunk
+    (me - 1 - h) departs at hop h and each receiver folds in its own
+    contribution, so after D-1 hops device me holds the full sum of its
+    own chunk's low half; counter-clockwise mirrors for the high half.
+    ``acc_ref``/``recv_ref`` are (2, 2, rows, 128) VMEM [direction,
+    slot]: the hop-h DMA lands in slot h%2 while the reduce-add that
+    prepares hop h+1 writes slot (h+1)%2 — the double buffer that lets
+    the adds overlap the in-flight DMAs.
+    """
+    me = lax.axis_index(axis_name)
+    right = lax.rem(me + 1, num_devices)
+    left = lax.rem(me - 1 + num_devices, num_devices)
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(
+        barrier, device_id=(right,),
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    pltpu.semaphore_signal(
+        barrier, device_id=(left,),
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    pltpu.semaphore_wait(barrier, 2)
+
+    # seed: the first chunk each stream pushes is the pure local partial
+    acc_ref[0, 0] = parts_ref[lax.rem(me - 1 + num_devices, num_devices), 0]
+    acc_ref[1, 0] = parts_ref[lax.rem(me + 1, num_devices), 1]
+
+    for h in range(num_devices - 1):
+        slot = h % 2
+        nxt = (h + 1) % 2
+        cw = pltpu.make_async_remote_copy(
+            src_ref=acc_ref.at[0, slot],
+            dst_ref=recv_ref.at[0, slot],
+            send_sem=send_sems.at[0, slot],
+            recv_sem=recv_sems.at[0, slot],
+            device_id=(right,),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        ccw = pltpu.make_async_remote_copy(
+            src_ref=acc_ref.at[1, slot],
+            dst_ref=recv_ref.at[1, slot],
+            send_sem=send_sems.at[1, slot],
+            recv_sem=recv_sems.at[1, slot],
+            device_id=(left,),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        cw.start()
+        ccw.start()
+        cw.wait()
+        ccw.wait()
+        # fold my contribution into the just-received partials; on the
+        # final hop the received chunk IS mine, so this add completes it
+        c_cw = lax.rem(me - 2 - h + 2 * num_devices, num_devices)
+        c_ccw = lax.rem(me + 2 + h, num_devices)
+        acc_ref[0, nxt] = recv_ref[0, slot] + parts_ref[c_cw, 0]
+        acc_ref[1, nxt] = recv_ref[1, slot] + parts_ref[c_ccw, 1]
+
+    last = (num_devices - 1) % 2
+    out_ref[0] = acc_ref[0, last]
+    out_ref[1] = acc_ref[1, last]
+
+
+def ring_reduce_scatter(x, axis_name: str, *, scatter_dimension: int = 0):
+    """Tiled reduce-scatter via the async bidirectional ring — the
+    drop-in contract of ``lax.psum_scatter(..., tiled=True)``, f32
+    accumulation. Falls back to the XLA collective off-TPU and for any
+    payload the kernel does not cover (chunk not splittable into two
+    lane-aligned halves).
+    """
+    d = _axis_size(axis_name)
+    if (
+        d == 1
+        or not ring_supported()
+        or x.shape[scatter_dimension] % d
+    ):
+        return lax.psum_scatter(
+            x, axis_name, scatter_dimension=scatter_dimension, tiled=True
+        )
+    dim = scatter_dimension
+    chunk = x.shape[dim] // d
+    parts = jnp.moveaxis(
+        x.reshape(x.shape[:dim] + (d, chunk) + x.shape[dim + 1:]), dim, 0
+    )
+    chunk_shape = parts.shape[1:]
+    n = 1
+    for s in chunk_shape:
+        n *= int(s)
+    rows = _half_rows(n)
+    if rows is None:
+        return lax.psum_scatter(
+            x, axis_name, scatter_dimension=dim, tiled=True
+        )
+    halves = parts.astype(jnp.float32).reshape(d, 2, rows, _LANES)
+    work = (2, 2, rows, _LANES)  # [direction, slot] double buffers
+    # in/out in VMEM (not ANY/HBM): the body reduce-adds directly on the
+    # refs, and the per-chunk halves are small by construction
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM(work, jnp.float32),     # acc
+            pltpu.VMEM(work, jnp.float32),     # recv
+            pltpu.SemaphoreType.DMA((2, 2)),   # send
+            pltpu.SemaphoreType.DMA((2, 2)),   # recv
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _rs_kernel, axis_name=axis_name, num_devices=d
+        ),
+        out_shape=jax.ShapeDtypeStruct((2, rows, _LANES), jnp.float32),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.TPUCompilerParams(collective_id=1),
+    )(halves)
+    return out.reshape(chunk_shape).astype(x.dtype)

@@ -13,6 +13,8 @@ Strategies (composable via mesh axes, see runtime/mesh.py):
 - ``fsdp``           — params/opt sharded on 'fsdp' along each leaf's largest
   divisible axis (ZeRO-3 style), batch on (data, fsdp).
 - tensor-parallel rules for transformer blocks live in ``partition.py``.
+- ``wire.py`` — graft-wire collective compression: ``WireConfig`` selects
+  int8-block payloads for the gradient collectives the step emits.
 """
 
 from distributed_pytorch_example_tpu.parallel.api import (  # noqa: F401
@@ -20,4 +22,8 @@ from distributed_pytorch_example_tpu.parallel.api import (  # noqa: F401
     data_parallel,
     fsdp,
     shard_largest_axis,
+)
+from distributed_pytorch_example_tpu.parallel.wire import (  # noqa: F401
+    WireConfig,
+    grad_wire_report,
 )

@@ -127,6 +127,7 @@ class Partitioner:
         dp_shard_opt_state: bool = False,
         opt_shard_axis: str = "data",
         opt_shard_min_size: int = DEFAULT_OPT_SHARD_MIN_SIZE,
+        wire=None,
     ):
         self.mesh = mesh
         self.rules = [(re.compile(pattern), spec) for pattern, spec in rules]
@@ -134,6 +135,10 @@ class Partitioner:
         self.dp_shard_opt_state = dp_shard_opt_state
         self.opt_shard_axis = opt_shard_axis
         self.opt_shard_min_size = opt_shard_min_size
+        # collective-compression policy (parallel/wire.py WireConfig or
+        # None = fp32 payloads); the step picks it up from here so one
+        # partitioner object carries the whole gradient-sync contract
+        self.wire = wire
         self._warned_fallbacks: set = set()  # one line per distinct cause
 
     def _fits(self, spec: P, shape: Tuple[int, ...]) -> bool:
@@ -303,6 +308,7 @@ def data_parallel(
     mesh: Mesh,
     dp_shard_opt_state: bool = False,
     opt_shard_min_size: int = DEFAULT_OPT_SHARD_MIN_SIZE,
+    wire=None,
 ) -> Partitioner:
     """Pure DP: everything replicated; batch on (data, fsdp).
 
@@ -310,12 +316,14 @@ def data_parallel(
     gradients mean-reduced across the data axes each step (DDP default,
     train.py:233). ``dp_shard_opt_state=True`` flips the update to ZeRO-1:
     grads reduce-scatter, optimizer state shards over ``data``, updated
-    params all-gather back (see module docstring).
+    params all-gather back (see module docstring). ``wire`` (a
+    ``parallel.wire.WireConfig``) compresses those gradient collectives.
     """
     return Partitioner(
         mesh, rules=(), default=P(),
         dp_shard_opt_state=dp_shard_opt_state,
         opt_shard_min_size=opt_shard_min_size,
+        wire=wire,
     )
 
 

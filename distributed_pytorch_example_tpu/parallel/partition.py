@@ -71,6 +71,7 @@ def transformer_partitioner(
     fsdp_rest: bool = False,
     dp_shard_opt_state: bool = False,
     opt_shard_min_size: int = DEFAULT_OPT_SHARD_MIN_SIZE,
+    wire=None,
 ) -> Partitioner:
     """TP rules for transformer blocks; remaining params replicated or FSDP.
 
@@ -118,4 +119,5 @@ def transformer_partitioner(
         mesh, rules=rules, default=default,
         dp_shard_opt_state=dp_shard_opt_state,
         opt_shard_min_size=opt_shard_min_size,
+        wire=wire,
     )

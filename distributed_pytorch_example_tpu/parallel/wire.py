@@ -1,0 +1,441 @@
+"""graft-wire: block-quantized gradient collectives (EQuARX-style).
+
+The reference's only collective is the fp32 gradient all-reduce (reference
+train.py:233 — DDP's bucketed backward hooks); our explicit ZeRO-1
+decomposition (train/step.py) still moves full-precision bytes every step.
+EQuARX (arxiv 2506.17615) shows a block-quantized all-reduce — int8
+payloads with per-block scales, quantized at the edge of every wire hop —
+recovers ~3x of that traffic at negligible quality cost. This module is
+the drop-in layer: ``wire_psum_scatter`` / ``wire_psum`` /
+``wire_all_gather`` replace the raw ``lax`` collectives inside the step's
+data-manual region, dispatching on a :class:`WireConfig`:
+
+- ``compress="none"``: byte-identical to the raw collective (the default;
+  every existing budget/equivalence bar is unchanged).
+- ``compress="int8-block"``: payloads quantize to int8 with one bf16
+  scale per ``block_size`` elements. int8 partial sums cannot ride an
+  in-network reduction (overflow, and every shard carries its own
+  scales), so the quantized reduce-scatter is recomposed as
+  *split-by-destination -> quantize -> all-to-all(s8) -> dequantize ->
+  f32 local sum* — same wire direction and volume as a ring
+  reduce-scatter, ~1/4 the bytes (1 payload byte + 2/block_size scale
+  bytes per element instead of 4). The quantized psum is that
+  reduce-scatter followed by a quantized all-gather of the reduced
+  chunk, so the plain-DP fallback path compresses too.
+
+What is deliberately NOT quantized by default:
+
+- Leaves below ``min_size`` elements: a handful of int8 blocks plus
+  scales for a bias saves nothing and costs latency (mirrors the ZeRO-1
+  ``opt_shard_min_size`` floor rationale).
+- The ZeRO-1 param re-replication all-gather. ``state.params`` after the
+  step IS the gathered buffer that feeds the next optimizer update, so a
+  lossy gather corrupts the f32 master weights a little more every step
+  — unlike gradient noise, that error is never averaged away.
+  ``param_gather="bf16"`` (or ``"int8-block"``) opts the gather into
+  compression via :func:`replicate_params` for bf16-tolerant runs; the
+  default keeps it exact (see README "Wire-efficient collectives").
+
+Stochastic rounding (``stochastic_rounding=True`` + a ``key``): rounds
+x to ``floor(x + u)``, ``u ~ U[0,1)`` — unbiased per element, so the
+quantization error of the gradient MEAN decays with the number of
+contributions instead of accumulating a deterministic bias.
+
+On TPU the uncompressed collectives (and the gather half of the
+compressed ones) can route through the Pallas async bidirectional-ring
+kernels (``ops/pallas/collectives.py``) when ``ring="auto"``; every
+backend that cannot lower them (the 8-device fake CPU mesh the tests run
+on) falls back to the XLA collective with identical numerics.
+
+``grad_wire_report`` is the analytic accounting side: per-device
+gradient-sync wire bytes per step from the param tree + partitioner +
+config, the quantity ``bench.py`` reports (``grad_wire_bytes_per_step``,
+``wire_compression_ratio``) and the comm-budget ``wire-int8-step``
+signature gates at >= 3x (analysis/collectives.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+COMPRESS_MODES = ("none", "int8-block")
+PARAM_GATHER_MODES = ("float32", "bf16", "int8-block")
+RING_MODES = ("auto", "off")
+
+# int8 symmetric range: +-127 (128 is reserved so negation stays exact)
+_QMAX = 127.0
+
+# leaves below this many ELEMENTS stay on the fp32 collective — scale
+# overhead + quantize latency beat the byte savings for biases/scalars
+# (same floor rationale as parallel/api.py DEFAULT_OPT_SHARD_MIN_SIZE)
+DEFAULT_MIN_SIZE = 2048
+
+
+@dataclasses.dataclass(frozen=True)
+class WireConfig:
+    """Collective-compression policy threaded Trainer -> train/step.py.
+
+    ``compress`` selects the gradient-sync payload ("none" | "int8-block");
+    ``block_size`` elements share one bf16 scale; ``stochastic_rounding``
+    makes the quantizer unbiased (needs a key at the call site);
+    ``param_gather`` opts the ZeRO-1 param re-replication into a lossy
+    gather ("float32" keeps it exact — module docstring for why that is
+    the default); ``ring`` gates the Pallas async ring kernels ("auto"
+    uses them where they lower, "off" forces the XLA collectives);
+    ``min_size`` is the element floor below which leaves keep fp32.
+    """
+
+    compress: str = "none"
+    block_size: int = 256
+    stochastic_rounding: bool = False
+    param_gather: str = "float32"
+    ring: str = "auto"
+    min_size: int = DEFAULT_MIN_SIZE
+
+    def __post_init__(self):
+        if self.compress not in COMPRESS_MODES:
+            raise ValueError(
+                f"WireConfig.compress must be one of {COMPRESS_MODES}, "
+                f"got {self.compress!r}"
+            )
+        if self.param_gather not in PARAM_GATHER_MODES:
+            raise ValueError(
+                f"WireConfig.param_gather must be one of "
+                f"{PARAM_GATHER_MODES}, got {self.param_gather!r}"
+            )
+        if self.ring not in RING_MODES:
+            raise ValueError(
+                f"WireConfig.ring must be one of {RING_MODES}, "
+                f"got {self.ring!r}"
+            )
+        if self.block_size < 1:
+            raise ValueError(
+                f"WireConfig.block_size must be >= 1, got {self.block_size}"
+            )
+
+    @property
+    def active(self) -> bool:
+        """Whether any wire surface differs from the raw collectives."""
+        return self.compress != "none" or self.param_gather != "float32"
+
+    def compresses(self, n_elements: int) -> bool:
+        """Whether a leaf of this many elements gets the int8 payload."""
+        return self.compress == "int8-block" and n_elements >= self.min_size
+
+
+# -- block quantizer -------------------------------------------------------
+
+
+def quantize_blocks(x, block_size: int, key=None):
+    """(values int8, scales bf16) with one scale per ``block_size`` elems.
+
+    ``x`` flattens row-major; the tail block zero-pads (the pad elements
+    quantize to 0 and are sliced off on dequantize). A ``key`` switches
+    round-to-nearest to unbiased stochastic rounding. All-zero blocks get
+    scale 0 and round-trip exactly.
+    """
+    rows, pad = _pad_rows(x.reshape(1, -1), block_size)
+    q, scales = _quantize_rows(rows, block_size, key)
+    return q[0], scales[0]
+
+
+def dequantize_blocks(q, scales, shape, dtype=jnp.float32):
+    """Inverse of :func:`quantize_blocks` back to ``shape``."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    flat = _dequantize_rows(q[None], scales[None], n, dtype)
+    return flat[0].reshape(shape)
+
+
+def _pad_rows(rows, block_size: int):
+    """(rows padded to a block multiple on axis 1, pad length)."""
+    pad = (-rows.shape[1]) % block_size
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)))
+    return rows, pad
+
+
+def _quantize_rows(rows, block_size: int, key=None):
+    """Per-row block quantization: (R, N) f32 -> (R, B, block) s8 +
+    (R, B, 1) bf16 scales, N a multiple of block_size."""
+    r, n = rows.shape
+    blocks = rows.astype(jnp.float32).reshape(r, n // block_size, block_size)
+    amax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+    scales = (amax / _QMAX).astype(jnp.bfloat16)
+    # zero blocks: scale 0, inverse 0 — values quantize to 0 exactly
+    inv = jnp.where(amax > 0.0, _QMAX / jnp.maximum(amax, 1e-30), 0.0)
+    scaled = blocks * inv
+    if key is not None:
+        # unbiased: floor(x + u), u ~ U[0,1) per element
+        u = jax.random.uniform(key, scaled.shape, jnp.float32)
+        rounded = jnp.floor(scaled + u)
+    else:
+        rounded = jnp.round(scaled)
+    q = jnp.clip(rounded, -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scales
+
+
+def _dequantize_rows(q, scales, n: int, dtype=jnp.float32):
+    """(R, B, block) s8 + (R, B, 1) bf16 -> (R, n) ``dtype`` (pad cut)."""
+    vals = q.astype(jnp.float32) * scales.astype(jnp.float32)
+    return vals.reshape(q.shape[0], -1)[:, :n].astype(dtype)
+
+
+# -- collective drop-ins (call INSIDE a shard_map manual over ``axis``) ----
+
+
+def _axis_size(axis_name: str) -> int:
+    # psum of a concrete python scalar folds to the static axis size —
+    # avoids lax.axis_index, which lowers to a PartitionId op the pre-0.9
+    # CPU SPMD partitioner cannot handle (see train/step.py body())
+    return int(lax.psum(1, axis_name))
+
+
+def _split_key(key, n: int):
+    if key is None:
+        return (None,) * n
+    return tuple(jax.random.split(key, n))
+
+
+def wire_psum_scatter(x, axis_name: str, *, scatter_dimension: int,
+                      config: Optional[WireConfig] = None, key=None):
+    """Drop-in ``lax.psum_scatter(..., tiled=True)`` with optional int8
+    payloads.
+
+    Quantized form (module docstring): split ``x`` into one chunk per
+    shard along ``scatter_dimension``, quantize each chunk, exchange via
+    ``all_to_all`` (s8 values + bf16 scales), dequantize the received
+    contributions and sum them in f32. Result matches the tiled
+    psum_scatter layout exactly; values differ only by the per-block
+    quantization error of each contribution.
+    """
+    config = config or WireConfig()
+    if not config.compresses(x.size):
+        return lax.psum_scatter(
+            x, axis_name, scatter_dimension=scatter_dimension, tiled=True
+        )
+    d = _axis_size(axis_name)
+    dim = scatter_dimension
+    if x.shape[dim] % d:
+        raise ValueError(
+            f"scatter dimension {dim} of shape {x.shape} must divide the "
+            f"'{axis_name}' span {d}"
+        )
+    chunk = x.shape[dim] // d
+    parts = jnp.moveaxis(
+        x.reshape(x.shape[:dim] + (d, chunk) + x.shape[dim + 1:]), dim, 0
+    )  # (d, ...) — one chunk per destination shard
+    chunk_shape = parts.shape[1:]
+    rows, _ = _pad_rows(parts.reshape(d, -1), config.block_size)
+    q, scales = _quantize_rows(
+        rows, config.block_size, key if config.stochastic_rounding else None
+    )
+    # the wire hop: each shard sends its quantized chunk j to shard j —
+    # the exact byte flow of a ring reduce-scatter, at s8 + scales
+    q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    scales = lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0)
+    n = 1
+    for s in chunk_shape:
+        n *= int(s)
+    got = _dequantize_rows(q, scales, n)  # (d, n): one row per source
+    return jnp.sum(got, axis=0).reshape(chunk_shape)
+
+
+def wire_all_gather(x, axis_name: str, *, gather_dimension: int = 0,
+                    config: Optional[WireConfig] = None, key=None):
+    """Drop-in tiled ``lax.all_gather`` with optional int8 payloads.
+
+    Quantized form: quantize the local shard once, gather the s8 values
+    and bf16 scales (via the Pallas ring kernel where it lowers,
+    ``ring="auto"``), dequantize every shard's contribution locally.
+    """
+    config = config or WireConfig()
+    if not config.compresses(x.size):
+        return _gather(x, axis_name, gather_dimension, config)
+    rows, _ = _pad_rows(x.reshape(1, -1), config.block_size)
+    q, scales = _quantize_rows(
+        rows, config.block_size, key if config.stochastic_rounding else None
+    )
+    q = _gather(q, axis_name, 0, config)          # (d, B, block) s8
+    scales = _gather(scales, axis_name, 0, config)  # (d, B, 1) bf16
+    got = _dequantize_rows(q, scales, x.size, x.dtype)  # (d, local size)
+    d = got.shape[0]
+    parts = got.reshape((d,) + x.shape)
+    return jnp.concatenate(
+        [parts[i] for i in range(d)], axis=gather_dimension
+    )
+
+
+def wire_psum(x, axis_name: str, *,
+              config: Optional[WireConfig] = None, key=None):
+    """Drop-in ``lax.psum`` with optional int8 payloads.
+
+    Quantized form: the all-reduce decomposes exactly like a ring
+    all-reduce — quantized reduce-scatter of the flattened leaf (padded
+    to a shard multiple) followed by a quantized all-gather of the
+    reduced chunk — so BOTH wire passes carry s8 + scales.
+    """
+    config = config or WireConfig()
+    if not config.compresses(x.size):
+        return lax.psum(x, axis_name)
+    d = _axis_size(axis_name)
+    k1, k2 = _split_key(key if config.stochastic_rounding else None, 2)
+    flat = x.reshape(-1)
+    pad = (-flat.size) % d
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    chunks = flat.reshape(d, -1)  # one destination chunk per shard
+    rows, _ = _pad_rows(chunks, config.block_size)
+    q, scales = _quantize_rows(rows, config.block_size, k1)
+    q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    scales = lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0)
+    reduced = jnp.sum(
+        _dequantize_rows(q, scales, chunks.shape[1]), axis=0
+    )  # this shard's fully reduced chunk, f32
+    rows2, _ = _pad_rows(reduced[None], config.block_size)
+    q2, scales2 = _quantize_rows(rows2, config.block_size, k2)
+    q2 = _gather(q2, axis_name, 0, config)
+    scales2 = _gather(scales2, axis_name, 0, config)
+    full = _dequantize_rows(q2, scales2, chunks.shape[1]).reshape(-1)
+    if pad:
+        full = full[: x.size]
+    return full.reshape(x.shape).astype(x.dtype)
+
+
+def _gather(x, axis_name: str, gather_dimension: int,
+            config: WireConfig):
+    """Tiled all-gather, through the Pallas async ring where it lowers."""
+    if config.ring != "off" and gather_dimension == 0:
+        from distributed_pytorch_example_tpu.ops.pallas import (
+            collectives as ring,
+        )
+
+        if ring.ring_supported():
+            return ring.ring_all_gather(x, axis_name)
+    return lax.all_gather(x, axis_name, axis=gather_dimension, tiled=True)
+
+
+# -- ZeRO-1 param re-replication ------------------------------------------
+
+
+def replicate_params(params: Any, partitioner, config: WireConfig,
+                     axis_name: str = "data"):
+    """Explicit wire-configured ZeRO-1 param re-replication all-gather.
+
+    The default step re-replicates updated params with a sharding
+    constraint (the implicit all-gather, train/step.py); this is the
+    explicit counterpart used when ``param_gather`` opts into a lossy
+    gather: each scatterable leaf enters sharded on its ZeRO-1 dim and
+    all-gathers back to replicated as bf16 (or int8 blocks), so the
+    gather moves 1/2 (or ~1/4) the bytes. Leaves the overlay left
+    unsharded pass through unchanged. See the module docstring for why
+    ``"float32"`` (the constraint path) is the default.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_pytorch_example_tpu.runtime import jax_compat
+
+    dims = partitioner.zero1_dims(params)
+    is_dim_leaf = lambda d: d is None  # noqa: E731 - tree of Optional[int]
+
+    def spec(dim, p):
+        if dim is None:
+            return P()
+        entries: list = [None] * p.ndim
+        entries[dim] = axis_name
+        return P(*entries)
+
+    in_specs = jax.tree_util.tree_map(
+        spec, dims, params, is_leaf=is_dim_leaf
+    )
+
+    def body(params):
+        def gather(dim, p):
+            if dim is None:
+                return p
+            if config.param_gather == "bf16":
+                out = _gather(
+                    p.astype(jnp.bfloat16), axis_name, dim, config
+                )
+                return out.astype(p.dtype)
+            return wire_all_gather(
+                p, axis_name, gather_dimension=dim, config=config
+            ).astype(p.dtype)
+
+        return jax.tree_util.tree_map(
+            gather, dims, params, is_leaf=is_dim_leaf
+        )
+
+    mapped = jax_compat.shard_map(
+        body,
+        partitioner.mesh,
+        in_specs=(in_specs,),
+        out_specs=jax.tree_util.tree_map(lambda _: P(), params),
+        axis_names={axis_name},
+    )
+    return mapped(params)
+
+
+# -- analytic wire accounting ----------------------------------------------
+
+
+def _bytes_per_element(config: WireConfig, n: int) -> float:
+    """Per-element payload bytes of ONE wire pass for an n-element leaf."""
+    if config.compresses(n):
+        # 1 s8 byte + one bf16 scale per block
+        return 1.0 + 2.0 / config.block_size
+    return 4.0  # f32
+
+
+def grad_wire_report(params: Any, partitioner,
+                     config: Optional[WireConfig] = None,
+                     axis_name: str = "data") -> dict:
+    """Analytic per-device gradient-sync wire bytes per optimizer step.
+
+    Ring-algorithm accounting per param leaf of n elements over a
+    D-shard axis: a reduce-scatter transmits ``(D-1)/D * n`` elements
+    per device, an all-reduce (RS + AG) twice that. Scatterable leaves
+    (the ZeRO-1 overlay dims) pay the RS factor; the rest pay the
+    all-reduce factor. This is deliberately the PAYLOAD model, not the
+    HLO result-buffer proxy ``analysis/collectives.py`` ratchets on —
+    an int8 all-to-all's result buffer (n bytes) is LARGER than a tiled
+    fp32 reduce-scatter's (n/D * 4), so result bytes cannot express the
+    wire win; the budget entry records both, and the ``wire-int8-step``
+    signature gates on this ratio plus the s8 payload's presence.
+    """
+    if config is None:
+        config = getattr(partitioner, "wire", None) or WireConfig()
+    d = int(partitioner.mesh.shape.get(axis_name, 1))
+    if partitioner.dp_shard_opt_state:
+        dims = partitioner.zero1_dims(params)
+    else:
+        dims = jax.tree_util.tree_map(lambda _: None, params)
+    is_dim_leaf = lambda x: x is None  # noqa: E731 - tree of Optional[int]
+    fp32_bytes = 0.0
+    wire_bytes = 0.0
+    ring_factor = (d - 1) / d if d > 1 else 0.0
+    for dim, leaf in zip(
+        jax.tree_util.tree_leaves(dims, is_leaf=is_dim_leaf),
+        jax.tree_util.tree_leaves(params),
+    ):
+        n = int(getattr(leaf, "size", 0) or 0)
+        passes = 1.0 if dim is not None else 2.0  # RS vs AR (= RS + AG)
+        fp32_bytes += passes * ring_factor * n * 4.0
+        wire_bytes += (
+            passes * ring_factor * n * _bytes_per_element(config, n)
+        )
+    ratio = fp32_bytes / wire_bytes if wire_bytes else 1.0
+    return {
+        "compress": config.compress,
+        "block_size": config.block_size,
+        "dp_degree": d,
+        "grad_wire_bytes_per_step_fp32": int(round(fp32_bytes)),
+        "grad_wire_bytes_per_step": int(round(wire_bytes)),
+        "wire_compression_ratio": round(ratio, 3),
+    }

@@ -112,6 +112,7 @@ class Trainer:
         max_bad_steps: int = 8,
         skip_nonfinite: bool = True,
         checkpoint_retain: int = ckpt_lib.DEFAULT_RETAIN,
+        wire=None,
     ):
         self.model = model
         self.task = task
@@ -141,10 +142,17 @@ class Trainer:
         # keep-last-K checkpoint generations (fallback ancestors for
         # corrupt-latest auto-recovery, train/checkpoint.py)
         self.checkpoint_retain = checkpoint_retain
+        # graft-wire collective compression (parallel/wire.py): explicit
+        # arg wins, else the partitioner's, else fp32 payloads
+        from distributed_pytorch_example_tpu.parallel.wire import WireConfig
+
+        if wire is None:
+            wire = getattr(partitioner, "wire", None) or WireConfig()
+        self.wire = wire
         self.train_step = build_train_step(
             model, task, optimizer,
             partitioner=partitioner, grad_accum_steps=grad_accum_steps,
-            skip_nonfinite=skip_nonfinite,
+            skip_nonfinite=skip_nonfinite, wire=wire,
         )
         self.eval_step = build_eval_step(model, task)
         self.state: Optional[TrainState] = None
@@ -177,6 +185,7 @@ class Trainer:
             self._telemetry_cfg = None
         self.scope: Optional[Telemetry] = None
         self.telemetry_summary: Dict[str, Any] = {}
+        self.wire_report: Optional[Dict[str, Any]] = None  # set in init()
         self._compiled: Dict[Any, Any] = {}  # AOT executables by shape key
         # >0: write `latest` every N train batches WITH the loader cursor
         # (epoch, batch_in_epoch) so resume restarts at the exact batch —
@@ -229,6 +238,28 @@ class Trainer:
             int(x.size) for x in jax.tree_util.tree_leaves(self.state.params)
         )
         logger.info("Model parameters: %s", f"{n_params:,}")
+        # analytic gradient-sync wire accounting (parallel/wire.py):
+        # per-device bytes per step + compression ratio, surfaced in the
+        # telemetry summary and bench.py's JSON line
+        if self.partitioner is not None:
+            from distributed_pytorch_example_tpu.parallel.wire import (
+                grad_wire_report,
+            )
+
+            self.wire_report = grad_wire_report(
+                self.state.params, self.partitioner, self.wire
+            )
+            if self.wire.compress != "none":
+                logger.info(
+                    "graft-wire: %s block=%d — grad sync %s B/step/device "
+                    "(fp32 %s, ratio %.2fx)",
+                    self.wire.compress, self.wire.block_size,
+                    f"{self.wire_report['grad_wire_bytes_per_step']:,}",
+                    f"{self.wire_report['grad_wire_bytes_per_step_fp32']:,}",
+                    self.wire_report["wire_compression_ratio"],
+                )
+        else:
+            self.wire_report = None
         return self.state
 
     def _sample_inputs_from(self, loader) -> Any:
@@ -725,6 +756,8 @@ class Trainer:
             intake.set_event_sink(None)  # armed at the top of fit
             if self.scope is not None:
                 self.telemetry_summary = self.scope.close()
+                if self.wire_report is not None:
+                    self.telemetry_summary["wire"] = dict(self.wire_report)
                 for loader in (train_loader, val_loader):
                     if loader is not None and hasattr(loader, "telemetry"):
                         loader.telemetry = None

@@ -32,6 +32,13 @@ sharding constraints alone lets the partitioner lower the partial-sum →
 tiled reshard as all-reduce + dynamic-slice (the CPU backend always does;
 TPU needs the ReduceScatterCreator pass to fire), whereas the explicit
 collective IS a reduce-scatter in the compiled HLO on every backend.
+
+Both explicit collectives route through ``parallel/wire.py`` (graft-wire):
+a ``WireConfig`` threaded from the partitioner (or passed directly)
+selects fp32 payloads (default, byte-identical to the raw ``lax``
+collectives) or int8-block compression, for the ZeRO-1 reduce-scatter AND
+the plain-DP psum fallback alike. The ``wire-raw-collective`` graft-lint
+rule pins the dispatch: this module must not call ``lax.psum*`` directly.
 """
 
 from __future__ import annotations
@@ -136,6 +143,7 @@ def build_train_step(
     grad_accum_steps: int = 1,
     sentinels: bool = True,
     skip_nonfinite: bool = True,
+    wire=None,
 ):
     """One compiled optimization step: (state, batch) -> (state, metrics).
 
@@ -143,6 +151,13 @@ def build_train_step(
     the default replicated mode and ``grad_accum_steps=1`` the compiled
     program is byte-identical to the historical step. ``grad_accum_steps=N``
     scans N microbatches before ONE deferred gradient collective.
+
+    ``wire`` (a ``parallel.wire.WireConfig``; defaults to the
+    partitioner's, else fp32) selects the gradient collective's payload.
+    ``compress="int8-block"`` forces the data axis manual even without
+    ZeRO-1/accumulation — compression needs the explicit collective —
+    and ``param_gather`` other than ``"float32"`` swaps the ZeRO-1
+    re-replication constraint for the explicit compressed all-gather.
 
     ``sentinels`` (default on) merges the graft-scope health scalars —
     global grad-norm, param-norm, nonfinite-grad count
@@ -164,12 +179,21 @@ def build_train_step(
     """
     if grad_accum_steps < 1:
         raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+    from distributed_pytorch_example_tpu.parallel import wire as wirelib
+
+    if wire is None:
+        wire = getattr(partitioner, "wire", None) or wirelib.WireConfig()
     zero1 = bool(partitioner is not None and partitioner.dp_shard_opt_state)
-    # Both new modes need the data axis MANUAL: ZeRO-1 for the explicit
+    wire_active = wire.compress != "none"
+    # All three modes need the data axis MANUAL: ZeRO-1 for the explicit
     # reduce-scatter, accumulation so the per-microbatch backward carries
     # no implicit data collective inside the scan (XLA's while-loop
-    # all-reduce motion would have to hoist it; manual mode never emits it)
-    manual_data = partitioner is not None and (zero1 or grad_accum_steps > 1)
+    # all-reduce motion would have to hoist it; manual mode never emits
+    # it), and wire compression because only the explicit collective can
+    # carry an int8 payload
+    manual_data = partitioner is not None and (
+        zero1 or grad_accum_steps > 1 or wire_active
+    )
 
     def compute_loss_grads(params, model_state, batch, rng):
         """Local (or global, in automatic mode) grads + metrics + new
@@ -254,16 +278,28 @@ def build_train_step(
 
             # the ONE deferred gradient collective per step: local grads
             # are d(local mean loss), so the global mean gradient is
-            # psum(...) / (data span * microbatch count)
+            # psum(...) / (data span * microbatch count). Payload per the
+            # WireConfig — fp32 collapses to the raw lax collective.
             scale = 1.0 / (dsize * grad_accum_steps)
+            wire_rng = (
+                jax.random.fold_in(rng, 0x77697265)  # b"wire"
+                if wire.stochastic_rounding and wire_active
+                else None
+            )
+            leaf_idx = [0]  # trace-order leaf counter for per-leaf keys
 
             def sync(dim, g):
+                key = None
+                if wire_rng is not None:
+                    key = jax.random.fold_in(wire_rng, leaf_idx[0])
+                leaf_idx[0] += 1
                 if dim is not None:
-                    g = jax.lax.psum_scatter(
-                        g, "data", scatter_dimension=dim, tiled=True
+                    g = wirelib.wire_psum_scatter(
+                        g, "data", scatter_dimension=dim, config=wire,
+                        key=key,
                     )
                 else:
-                    g = jax.lax.psum(g, "data")
+                    g = wirelib.wire_psum(g, "data", config=wire, key=key)
                 return g * scale
 
             grads = jax.tree_util.tree_map(
@@ -355,10 +391,19 @@ def build_train_step(
             # the moments sharded (a propagation choice to replicate them
             # would silently undo the memory win — the comm-budget gate
             # also watches for this), and the updated params re-replicate
-            # over 'data' — this constraint IS the ZeRO-1 all-gather
-            new_params = jax.lax.with_sharding_constraint(
-                new_params, partitioner.tree_shardings(new_params)
-            )
+            # over 'data' — this constraint IS the ZeRO-1 all-gather.
+            # param_gather other than "float32" swaps the constraint for
+            # the explicit lossy gather (opt-in: the gathered buffer is
+            # next step's master weights, so compression error there
+            # accumulates — parallel/wire.py module docstring)
+            if wire.param_gather != "float32":
+                new_params = wirelib.replicate_params(
+                    new_params, partitioner, wire
+                )
+            else:
+                new_params = jax.lax.with_sharding_constraint(
+                    new_params, partitioner.tree_shardings(new_params)
+                )
             new_opt_state = jax.lax.with_sharding_constraint(
                 new_opt_state,
                 partitioner.tree_shardings(

@@ -161,6 +161,28 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         help="global-norm gradient clipping threshold")
     parser.add_argument("--grad-accum", type=int, default=1,
                         help="accumulate k micro-steps per optimizer step")
+    parser.add_argument("--zero1", action="store_true",
+                        help="ZeRO-1: shard optimizer state over the data "
+                        "axis (reduce-scattered grads + param "
+                        "re-replication; parallel/api.py zero1_overlay)")
+    parser.add_argument("--wire", type=str, default="none",
+                        choices=("none", "int8-block"),
+                        help="graft-wire gradient-collective compression: "
+                        "int8-block = int8 payloads with per-block bf16 "
+                        "scales on the gradient sync (~4x fewer wire "
+                        "bytes; parallel/wire.py)")
+    parser.add_argument("--wire-block", type=int, default=256,
+                        help="elements per bf16 scale block for "
+                        "--wire int8-block")
+    parser.add_argument("--wire-stochastic", action="store_true",
+                        help="stochastic rounding in the wire quantizer "
+                        "(unbiased gradient mean; default round-to-nearest)")
+    parser.add_argument("--wire-param-gather", type=str, default="float32",
+                        choices=("float32", "bf16", "int8-block"),
+                        help="payload of the ZeRO-1 param re-replication "
+                        "all-gather; float32 keeps master weights exact "
+                        "(lossy modes are opt-in — the gathered buffer "
+                        "feeds the next update)")
     parser.add_argument("--max-bad-steps", type=int, default=8,
                         help="nonfinite steps skipped device-side before "
                         "rolling back to the last good checkpoint (a second "

@@ -2,8 +2,10 @@
 # Pre-commit fast path: the backend-free graft-lint rule set (<5s).
 #
 # Runs every AST lint fixture plus the shipped-clean gates (the real
-# serving/train modules must carry zero findings) without initializing a
-# JAX backend, so it is safe on any box — laptop, CI, or the TPU host.
+# serving/train modules must carry zero findings — including the
+# wire-raw-collective rule pinning train/step.py's gradient sync to the
+# parallel/wire.py dispatch) without initializing a JAX backend, so it
+# is safe on any box — laptop, CI, or the TPU host.
 #
 #   ./scripts/precommit.sh
 #

@@ -13,11 +13,14 @@ Usage:
     python scripts/profile_step.py --model gpt2 --seq-len 1024 --batch 16
     python scripts/profile_step.py --seq-len 16384 --batch 1 --remat
     python scripts/profile_step.py --zero1 --grad-accum 4  # RS+AG sync
+    python scripts/profile_step.py --zero1 --wire int8-block  # graft-wire
 
 Before tracing, prints the compiled step's collective mix (kind, count,
-result bytes) to stderr — the quick check that the gradient sync is the
-one you asked for (ZeRO-1: reduce-scatter + all-gather, no gradient
-all-reduce; replicated: all-reduce).
+result bytes, per-dtype byte split) to stderr — the quick check that the
+gradient sync is the one you asked for (ZeRO-1: reduce-scatter +
+all-gather, no gradient all-reduce; replicated: all-reduce; --wire
+int8-block: s8 all-to-all payloads plus the analytic graft-wire
+bytes-on-the-wire report and compression ratio).
 """
 
 from __future__ import annotations
@@ -48,6 +51,13 @@ def main():
                         "sharded update + all-gather)")
     parser.add_argument("--grad-accum", type=int, default=1,
                         help="in-step microbatch accumulation")
+    parser.add_argument("--wire", default="none",
+                        choices=("none", "int8-block"),
+                        help="graft-wire collective compression (int8 "
+                        "payloads + per-block bf16 scales on the grad sync)")
+    parser.add_argument("--wire-block", type=int, default=256,
+                        help="elements per bf16 scale block for "
+                        "--wire int8-block")
     parser.add_argument("--trace-dir", default="/tmp/profile_step")
     parser.add_argument("--trace-steps", type=int, default=3)
     parser.add_argument("--top", type=int, default=30)
@@ -97,7 +107,10 @@ def main():
         sample_key = "tokens"
     mesh = dpx.runtime.make_mesh()
     partitioner = dpx.parallel.data_parallel(
-        mesh, dp_shard_opt_state=args.zero1
+        mesh, dp_shard_opt_state=args.zero1,
+        wire=dpx.parallel.WireConfig(
+            compress=args.wire, block_size=args.wire_block
+        ),
     )
     trainer = dpx.train.Trainer(
         model, task, optax.adam(1e-3), partitioner=partitioner,
@@ -115,17 +128,34 @@ def main():
         # what the gradient sync compiled to — ZeRO-1 should show
         # reduce-scatter + all-gather, replicated mode all-reduce only
         from distributed_pytorch_example_tpu.analysis.collectives import (
+            parse_collective_dtypes,
             parse_collectives,
         )
 
-        comms = parse_collectives(compiled.as_text())
-        print("step collectives (kind: count / result bytes):",
+        hlo = compiled.as_text()
+        comms = parse_collectives(hlo)
+        dtypes = parse_collective_dtypes(hlo)
+        print("step collectives (kind: count / result bytes [dtype mix]):",
               file=sys.stderr)
         for kind, rec in sorted(comms.items()):
-            print(f"  {kind}: {rec['count']} / {rec['bytes']}",
+            mix = ", ".join(
+                f"{dt}={b}" for dt, b in sorted(dtypes.get(kind, {}).items())
+            )
+            print(f"  {kind}: {rec['count']} / {rec['bytes']} [{mix}]",
                   file=sys.stderr)
         if not comms:
             print("  (none — single-device program)", file=sys.stderr)
+        if args.wire != "none" and trainer.wire_report is not None:
+            # analytic ring-model wire bytes (HLO result buffers under-
+            # count the a2a payload; parallel/wire.py grad_wire_report)
+            wr = trainer.wire_report
+            print(
+                f"graft-wire: compress={wr['compress']} grad sync "
+                f"{wr['grad_wire_bytes_per_step']:,} B/step/device "
+                f"(fp32 {wr['grad_wire_bytes_per_step_fp32']:,}, "
+                f"ratio {wr['wire_compression_ratio']:.2f}x)",
+                file=sys.stderr,
+            )
         from distributed_pytorch_example_tpu.telemetry import (
             compiled_cost_record,
         )

@@ -400,6 +400,50 @@ def test_fleet_unbounded_wait_covers_data_scope():
 
 
 @pytest.mark.lint
+def test_wire_raw_collective_fires_in_step_scope():
+    # a raw gradient collective in the step bypasses the WireConfig
+    # dispatch — fp32 payloads regardless of --wire int8-block
+    src = (
+        "from jax import lax\n"
+        "def sync(g):\n"
+        "    g = lax.psum_scatter(g, 'data', scatter_dimension=0)\n"
+        "    return lax.psum(g, 'data')\n"
+    )
+    findings = pylint_rules.lint_source("train/step.py", src)
+    assert _rules(findings) == ["wire-raw-collective"] * 2
+    assert "parallel/wire.py" in findings[0].message
+
+
+@pytest.mark.lint
+def test_wire_raw_collective_scope_suppression_and_lookalikes():
+    src = (
+        "from jax import lax\n"
+        "def sync(g):\n"
+        "    return lax.psum(g, 'data')\n"
+    )
+    # only train/step.py is in scope: wire.py ITSELF implements the
+    # fallbacks with raw collectives, as do other manual regions
+    assert pylint_rules.lint_source("parallel/wire.py", src) == []
+    assert pylint_rules.lint_source("ops/pallas/collectives.py", src) == []
+    supp = src.replace(
+        "lax.psum(g, 'data')",
+        "lax.psum(g, 'data')  # graft-lint: wire-raw-collective",
+    )
+    assert pylint_rules.lint_source("train/step.py", supp) == []
+    # the sanctioned spellings never fire: the wire_* wrappers and the
+    # metrics pmean
+    ok = (
+        "from jax import lax\n"
+        "from distributed_pytorch_example_tpu.parallel import wire\n"
+        "def sync(g, m):\n"
+        "    g = wire.wire_psum_scatter(g, 'data', scatter_dimension=0)\n"
+        "    g = wire.wire_psum(g, 'data')\n"
+        "    return g, lax.pmean(m, 'data')\n"
+    )
+    assert pylint_rules.lint_source("train/step.py", ok) == []
+
+
+@pytest.mark.lint
 def test_fleet_real_modules_lint_clean():
     # the acceptance gate: the shipped fleet/router layers carry a
     # timeout on every blocking wait, as committed
@@ -557,6 +601,86 @@ def test_compare_budgets_stash_signature():
     # without the signature the same marker drift is invisible
     assert coll.compare_budgets(committed, measured, markers=fell_back)[0] \
         == []
+
+
+@pytest.mark.lint
+def test_compare_budgets_wire_signature():
+    """The wire-int8-step structural contract: an s8 collective payload,
+    the re-replication all-gather, and the >=3x analytic ratio must all
+    hold — a silent fp32 fallback changes no count/byte ratchet (the
+    fp32 collectives fit comfortably inside a stale compressed budget's
+    tolerance on this toy scale), so only the signature can catch it."""
+    committed = {
+        "all-to-all": {"count": 40, "bytes": 4000},
+        "all-gather": {"count": 20, "bytes": 2000},
+    }
+    measured = dict(committed)
+    ok_dtypes = {
+        "all-to-all": {"s8": 3000, "bf16": 1000},
+        "all-gather": {"s8": 1500, "bf16": 500},
+    }
+    ok_wire = {"wire_compression_ratio": 3.97}
+
+    v, _ = coll.compare_budgets(
+        committed, measured, signature="wire-int8-step",
+        dtypes=ok_dtypes, wire=ok_wire,
+    )
+    assert v == []
+
+    # silent fp32 fallback: all-f32 payloads + no compression ratio
+    v, _ = coll.compare_budgets(
+        committed, measured, signature="wire-int8-step",
+        dtypes={"all-to-all": {"f32": 4000}}, wire=None,
+    )
+    assert _rules(v) == ["comm-wire-signature"] * 2
+    assert {f.where for f in v} == {"s8-payload", "wire_compression_ratio"}
+
+    # no dtype breakdown at all (hand-edited budget refresh): still loud
+    v, _ = coll.compare_budgets(
+        committed, measured, signature="wire-int8-step",
+        dtypes=None, wire=ok_wire,
+    )
+    assert _rules(v) == ["comm-wire-signature"]
+    assert v[0].where == "s8-payload"
+
+    # the param re-replication all-gather must survive compression
+    v, _ = coll.compare_budgets(
+        committed, {"all-to-all": {"count": 40, "bytes": 4000}},
+        signature="wire-int8-step", dtypes=ok_dtypes, wire=ok_wire,
+    )
+    assert any(f.where == "all-gather" for f in v)
+
+    # a sub-3x ratio fails even with the s8 payload present
+    v, _ = coll.compare_budgets(
+        committed, measured, signature="wire-int8-step",
+        dtypes=ok_dtypes, wire={"wire_compression_ratio": 2.4},
+    )
+    assert _rules(v) == ["comm-wire-signature"]
+    assert v[0].where == "wire_compression_ratio"
+
+    # without the signature the fp32 fallback sails through: the
+    # signature is load-bearing, not redundant with the ratchet
+    v, _ = coll.compare_budgets(
+        committed, measured, dtypes={"all-to-all": {"f32": 4000}},
+    )
+    assert v == []
+
+
+@pytest.mark.lint
+def test_parse_collective_dtypes_breakdown():
+    got = coll.parse_collective_dtypes(_HLO_FIXTURE)
+    assert got["all-reduce"] == {"f32": 4 * 16 * 4}
+    # async pair counts once, from the start tuple's full byte set
+    assert got["all-gather"] == {"f32": (4 * 16 + 8 * 16) * 4}
+    assert got["reduce-scatter"] == {"bf16": 2 * 16 * 2}
+    s8_fixture = (
+        "HloModule m\nENTRY e {\n"
+        "  %a2a = s8[4,64]{1,0} all-to-all(s8[4,64]{1,0} %q)\n"
+        "  %sc = bf16[4,1]{1,0} all-to-all(bf16[4,1]{1,0} %s)\n"
+        "}\n"
+    )
+    got = coll.parse_collective_dtypes(s8_fixture)
+    assert got["all-to-all"] == {"s8": 4 * 64, "bf16": 4 * 1 * 2}
 
 
 # ---------------------------------------------------------------------------

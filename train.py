@@ -285,6 +285,10 @@ def main():
 
     pipelined = args.mesh_pipe not in (0, 1)
     if args.partition == "fsdp" and not pipelined:
+        if args.zero1:
+            parser.error("--zero1 is redundant under --partition fsdp "
+                         "(FSDP already shards optimizer state with the "
+                         "params)")
         partitioner = dpx.parallel.fsdp(mesh)
     elif args.partition == "tp" or pipelined:
         # pipelined runs need the stacked-param rules (stage stacks sharded
@@ -296,10 +300,21 @@ def main():
         )
 
         partitioner = transformer_partitioner(
-            mesh, fsdp_rest=args.partition == "fsdp"
+            mesh, fsdp_rest=args.partition == "fsdp",
+            dp_shard_opt_state=args.zero1,
         )
     else:
-        partitioner = dpx.parallel.data_parallel(mesh)
+        partitioner = dpx.parallel.data_parallel(
+            mesh, dp_shard_opt_state=args.zero1
+        )
+    # graft-wire collective compression: carried by the partitioner so the
+    # step, budgets, and telemetry all read one policy object
+    partitioner.wire = dpx.parallel.WireConfig(
+        compress=args.wire,
+        block_size=args.wire_block,
+        stochastic_rounding=args.wire_stochastic,
+        param_gather=args.wire_param_gather,
+    )
 
     train_loader = dpx.data.DeviceLoader(
         train_ds, global_batch, mesh=mesh, shuffle=True, seed=args.seed
