@@ -427,6 +427,43 @@ def run_model(name: str, args) -> dict:
             ).astype(np.float32),
             "y": rng.integers(0, num_classes, (global_batch,)).astype(np.int32),
         }
+    picked_plan = None
+    if args.auto_mesh:
+        # graft-plan: replace the flag-built mesh/partitioner with the
+        # static oracle's pick (the batch shapes above are plan-neutral)
+        if pipelined or args.zero1 or args.wire != "none":
+            raise ValueError(
+                "--auto-mesh replaces --mesh-pipe/--zero1/--wire; "
+                "drop those flags"
+            )
+        from distributed_pytorch_example_tpu.analysis import (
+            envelope,
+            planner,
+        )
+
+        batch_abs = {
+            k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+            for k, v in batch_np.items()
+        }
+        best, _ = planner.pick_train_plan(
+            model, task, optax.adam(1e-3),
+            batch_abs["tokens" if lm else "x"], batch_abs,
+            kind="lm" if lm else "image",
+            program=f"train/{name}",
+            hbm_limit=envelope.hbm_limit_from_env(),
+            wire_block=args.wire_block,
+            log=lambda m: print(m, file=sys.stderr),
+        )
+        if best is None:
+            raise ValueError(f"--auto-mesh: no feasible plan for {name}")
+        picked_plan = best.plan.name()
+        print(
+            f"bench: --auto-mesh picked {best.plan.name()} "
+            f"(tier {best.tier}, cost {best.cost_ms():.4f} ms)",
+            file=sys.stderr,
+        )
+        mesh = dpx.runtime.make_mesh(best.plan.mesh)
+        partitioner = best.plan.lower(mesh=mesh)
     trainer = dpx.train.Trainer(
         model, task, optax.adam(1e-3), partitioner=partitioner,
         grad_accum_steps=args.grad_accum,
@@ -578,6 +615,7 @@ def run_model(name: str, args) -> dict:
                 else {}
             ),
             **({"chaos": args.chaos} if args.chaos != "none" else {}),
+            **({"auto_mesh": picked_plan} if picked_plan else {}),
         },
     }
     if chaos_report is not None:
@@ -649,6 +687,12 @@ def main():
     parser.add_argument("--wire-block", type=int, default=256,
                         help="elements per bf16 scale block for "
                         "--wire int8-block")
+    parser.add_argument("--auto-mesh", action="store_true",
+                        help="graft-plan: pick mesh + partitioner per model "
+                        "via the static three-tier oracle "
+                        "(analysis/planner.py) instead of "
+                        "--mesh-pipe/--zero1/--wire; DPX_HBM_LIMIT gates "
+                        "would-OOM plans pre-compile")
     parser.add_argument("--zero1", action="store_true",
                         help="ZeRO-1: reduce-scatter grads, shard the "
                         "optimizer state over data, all-gather params")

@@ -1,6 +1,6 @@
 """Repo-specific Python AST lints (no jax import, no backend).
 
-Ten rules, each a distilled past-regression class:
+Eleven rules, each a distilled past-regression class:
 
 - ``host-sync``: ``.item()`` / ``np.asarray`` / ``jax.device_get`` inside
   TRACED-SCOPE sources (``ops/``, ``models/``, ``parallel/``,
@@ -85,6 +85,19 @@ Ten rules, each a distilled past-regression class:
   source level before any compile. ``pmean`` (metrics averaging) and
   the ``wire_*`` wrappers themselves are fine.
 
+- ``plan-overlay``: a ``P(...)`` / ``PartitionSpec(...)`` construction
+  with a STRING-LITERAL axis name inside ``parallel/api.py`` or
+  ``train/step.py``. graft-plan's contract is that every sharding those
+  modules emit lowers through a ``PlanSpec`` (``parallel/plan.py``) —
+  the single description the static planner scores, the budget auditor
+  keys on, and the factories lower. A hard-coded ``P("data", ...)``
+  added beside the plan path is an overlay the planner cannot see: the
+  planner ranks one program, the step runs another, and the committed
+  budget signatures drift from the shipped shardings. Dynamic
+  construction — ``P()``, ``P(*entries)``, ``P(axis_var)``, names
+  built from the plan's mesh axes — is the sanctioned pattern; only
+  literal axis strings (bare or inside tuple/list literals) fire.
+
 Scope is static and name-based, not a whole-program call graph — the
 cheap 99% of the check. Deliberate exceptions carry a
 ``# graft-lint: ok`` (all rules) or ``# graft-lint: <rule>`` comment on
@@ -118,6 +131,10 @@ WAIT_SCOPE = ("serving/", "data/")
 # dispatch (parallel/wire.py) — a raw lax.psum*/psum_scatter in the step
 # bypasses the WireConfig compression policy
 WIRE_RAW_SCOPE = ("train/step.py",)
+# plan-overlay pins the shipped sharding surfaces to the PlanSpec
+# lowering (parallel/plan.py) — a string-literal PartitionSpec in either
+# module is an ad-hoc overlay the static planner cannot score
+PLAN_OVERLAY_SCOPE = ("parallel/api.py", "train/step.py")
 
 _ACCUM_CTORS = ("zeros", "zeros_like", "full", "empty")
 
@@ -389,6 +406,56 @@ def _serve_dynamic_shape_findings(
     return [flagged[k] for k in sorted(flagged)]
 
 
+def _holds_str_literal(node: ast.AST) -> bool:
+    """Whether an expression IS a string literal or a tuple/list literal
+    containing one (any nesting depth)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(_holds_str_literal(e) for e in node.elts)
+    return False
+
+
+def _plan_overlay_findings(
+    tree: ast.Module, relpath: str, supp: Dict[int, Set[str]]
+) -> List[Finding]:
+    """String-literal PartitionSpec construction bypassing the PlanSpec
+    lowering (module docstring: the graft-plan contract)."""
+    flagged: Dict[int, Finding] = {}  # keyed by line: nesting dedup
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if name not in ("P", "PartitionSpec"):
+            continue
+        # only literal axis strings fire: P(), P(*entries), P(axis_var)
+        # are the sanctioned dynamic construction (ast.Starred is not a
+        # Constant/Tuple/List, so it falls through)
+        literal = any(_holds_str_literal(a) for a in node.args) or any(
+            _holds_str_literal(k.value) for k in node.keywords
+        )
+        if not literal:
+            continue
+        if _suppressed(supp, node.lineno, "plan-overlay"):
+            continue
+        flagged.setdefault(node.lineno, Finding(
+            rule="plan-overlay",
+            where=f"{relpath}:{node.lineno}",
+            message=(
+                f"{name}(...) built from a string-literal axis name "
+                "bypasses the PlanSpec lowering: the static planner "
+                "scores PlanSpec-derived shardings only, so an ad-hoc "
+                "overlay here silently diverges the ranked program from "
+                "the shipped one — derive axis names from the plan/mesh "
+                "(parallel/plan.py) or construct the spec dynamically"
+            ),
+        ))
+    return [flagged[k] for k in sorted(flagged)]
+
+
 _WAIT_NAMES = ("get", "wait", "join")
 
 
@@ -630,6 +697,8 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
         findings.extend(_serve_dynamic_shape_findings(tree, relpath, supp))
     if _in_scope(relpath, WAIT_SCOPE):
         findings.extend(_fleet_unbounded_wait_findings(tree, relpath, supp))
+    if _in_scope(relpath, PLAN_OVERLAY_SCOPE):
+        findings.extend(_plan_overlay_findings(tree, relpath, supp))
     return findings
 
 

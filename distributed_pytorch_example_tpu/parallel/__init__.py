@@ -15,6 +15,8 @@ Strategies (composable via mesh axes, see runtime/mesh.py):
 - tensor-parallel rules for transformer blocks live in ``partition.py``.
 - ``wire.py`` — graft-wire collective compression: ``WireConfig`` selects
   int8-block payloads for the gradient collectives the step emits.
+- ``plan.py`` — :class:`PlanSpec`, the declarative plan every factory above
+  lowers; ``analysis/planner.py`` searches over it (``--auto-mesh``).
 """
 
 from distributed_pytorch_example_tpu.parallel.api import (  # noqa: F401
@@ -22,6 +24,9 @@ from distributed_pytorch_example_tpu.parallel.api import (  # noqa: F401
     data_parallel,
     fsdp,
     shard_largest_axis,
+)
+from distributed_pytorch_example_tpu.parallel.plan import (  # noqa: F401
+    PlanSpec,
 )
 from distributed_pytorch_example_tpu.parallel.wire import (  # noqa: F401
     WireConfig,

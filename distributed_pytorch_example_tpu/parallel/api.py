@@ -289,6 +289,37 @@ class Partitioner:
             self.tree_specs(tree, path_prefix=path_prefix),
         )
 
+    # -- manual (shard_map) gradient-sync contract -------------------------
+    # train/step.py's data-manual region derives every spec and axis name
+    # from these helpers, so axis placement has a single source of truth:
+    # the PlanSpec lowering that built this partitioner (the plan-overlay
+    # graft-lint rule rejects hand-built axis-name specs in the step).
+
+    def grad_sync_axis(self) -> str:
+        """Mesh axis the manual gradient collectives run over."""
+        return self.opt_shard_axis
+
+    def manual_batch_spec(self) -> P:
+        """Batch in_spec for the data-manual region (leading dim sharded)."""
+        return P((self.opt_shard_axis,))
+
+    def manual_axis_spec(self) -> P:
+        """Spec of a 1-D array with one element per sync-axis shard."""
+        return P(self.opt_shard_axis)
+
+    def grad_scatter_spec(self, dim: Optional[int], ndim: int) -> P:
+        """out_spec of one synced grad leaf.
+
+        ``dim`` is the leaf's ZeRO-1 overlay dim (``zero1_dims``): the
+        psum_scatter lands the shard there; None means the leaf psums to
+        replicated.
+        """
+        if dim is None:
+            return P()
+        entries: list = [None] * ndim
+        entries[dim] = self.opt_shard_axis
+        return P(*entries)
+
     def batch_spec(self) -> P:
         """Leading-dim sharding over the joint data axes (global batch)."""
         return P(mesh_lib.data_axes(self.mesh))
@@ -318,15 +349,26 @@ def data_parallel(
     grads reduce-scatter, optimizer state shards over ``data``, updated
     params all-gather back (see module docstring). ``wire`` (a
     ``parallel.wire.WireConfig``) compresses those gradient collectives.
+
+    Lowers ``PlanSpec(family="data", ...)`` (parallel/plan.py) — the spec is
+    the single source of the rule set; this wrapper keeps the legacy call
+    signature.
     """
-    return Partitioner(
-        mesh, rules=(), default=P(),
-        dp_shard_opt_state=dp_shard_opt_state,
+    from distributed_pytorch_example_tpu.parallel.plan import PlanSpec
+
+    return PlanSpec(
+        family="data",
+        zero1=dp_shard_opt_state,
         opt_shard_min_size=opt_shard_min_size,
         wire=wire,
-    )
+    ).lower(mesh=mesh)
 
 
 def fsdp(mesh: Mesh, axis: str = "fsdp") -> Partitioner:
-    """ZeRO-3-style: every param/moment leaf sharded on its largest dim."""
-    return Partitioner(mesh, rules=((r".*", shard_largest_axis(axis, mesh)),))
+    """ZeRO-3-style: every param/moment leaf sharded on its largest dim.
+
+    Lowers ``PlanSpec(family="fsdp", fsdp_axis=axis)`` (parallel/plan.py).
+    """
+    from distributed_pytorch_example_tpu.parallel.plan import PlanSpec
+
+    return PlanSpec(family="fsdp", fsdp_axis=axis).lower(mesh=mesh)

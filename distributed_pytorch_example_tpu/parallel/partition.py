@@ -26,8 +26,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distributed_pytorch_example_tpu.parallel.api import (
     DEFAULT_OPT_SHARD_MIN_SIZE,
     Partitioner,
-    Rule,
-    shard_largest_axis,
 )
 
 # Paths follow the naming contract of models/transformer.py:
@@ -93,31 +91,17 @@ def transformer_partitioner(
     inserts the collectives), so the biggest matmul and table never
     replicate across tensor shards. Indivisible vocab sizes fall back to
     the default policy.
+
+    Lowers ``PlanSpec(family="transformer", ...)`` (parallel/plan.py), where
+    the rule assembly (this table + the vocab-parallel shape callables) now
+    lives; this wrapper keeps the legacy call signature.
     """
-    default = shard_largest_axis("fsdp", mesh) if fsdp_rest else P()
+    from distributed_pytorch_example_tpu.parallel.plan import PlanSpec
 
-    def _default_spec(shape):
-        return default(shape) if callable(default) else default
-
-    tsize = mesh.shape.get("tensor", 1)
-
-    def vocab_embed(shape):  # (V, D)
-        if tsize > 1 and shape and shape[0] % tsize == 0:
-            return P("tensor", None)
-        return _default_spec(shape)
-
-    def vocab_head(shape):  # (D, V)
-        if tsize > 1 and shape and shape[-1] % tsize == 0:
-            return P(None, "tensor")
-        return _default_spec(shape)
-
-    rules: list[Rule] = list(TRANSFORMER_TP_RULES) + [
-        (r"(wte|tok_embed)/embedding$", vocab_embed),
-        (r"lm_head$", vocab_head),
-    ]
-    return Partitioner(
-        mesh, rules=rules, default=default,
-        dp_shard_opt_state=dp_shard_opt_state,
+    return PlanSpec(
+        family="transformer",
+        fsdp_rest=fsdp_rest,
+        zero1=dp_shard_opt_state,
         opt_shard_min_size=opt_shard_min_size,
         wire=wire,
-    )
+    ).lower(mesh=mesh)

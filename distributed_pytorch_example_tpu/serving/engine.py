@@ -391,6 +391,48 @@ class InferenceEngine:
                 out[name] = (jaxpr, specs)
         return out
 
+    def plan_programs(self, partitioner) -> dict:
+        """``{name: (closed_jaxpr, in_specs)}`` traced AS IF served under
+        ``partitioner`` — graft-plan's per-plan serve oracle.
+
+        Unlike :meth:`traced_programs` (which reads the COMMITTED shardings
+        of this engine's placed arrays), the candidate partitioner supplies
+        the mesh / batch axes / param specs, and nothing is placed or
+        executed: the same representative args are traced under the
+        candidate mesh, so prefill and decode can be scored for a plan
+        without building an engine per plan (zero XLA compiles).
+        """
+        import functools
+
+        kw = dict(
+            temperature=self.temperature, top_k=self.top_k, top_p=self.top_p,
+        )
+        batch_axes = partitioner.batch_spec()[0]
+        if isinstance(batch_axes, str):
+            batch_axes = (batch_axes,)
+        kw.update(mesh=partitioner.mesh, batch_axes=tuple(batch_axes or ()))
+
+        from jax.sharding import PartitionSpec as P
+
+        param_specs = jax.tree_util.tree_leaves(
+            partitioner.tree_specs(self.params),
+            is_leaf=lambda s: isinstance(s, P),
+        )
+        n_params = len(jax.tree_util.tree_leaves(self.params))
+        out = {}
+        args = self._program_args()
+        with partitioner.mesh:
+            for name, (fn, rest) in args.items():
+                wrapped = functools.partial(fn, self.model, **kw)
+                jaxpr = jax.make_jaxpr(wrapped)(*rest)
+                n_rest = len(jax.tree_util.tree_leaves(rest))
+                # flat order: params leaves first (rest[0]), then cache /
+                # tokens / keys — replicated until the in-program
+                # constraints place them (a free reshard in shardflow)
+                specs = list(param_specs) + [None] * (n_rest - n_params)
+                out[name] = (jaxpr, specs)
+        return out
+
     def _program_args(self) -> dict:
         """Representative (jitted_fn, traced_args) per program name."""
         ns = self.config.num_slots

@@ -53,24 +53,9 @@ from distributed_pytorch_example_tpu.parallel.api import Partitioner
 from distributed_pytorch_example_tpu.train.state import TrainState
 
 
-def init_state(
-    model,
-    optimizer: optax.GradientTransformation,
-    sample_inputs: Any,
-    rng: jax.Array,
-    partitioner: Optional[Partitioner] = None,
-) -> Tuple[TrainState, Any]:
-    """Create a TrainState, placed per the partitioner's rules.
-
-    Initialization runs under jit with ``out_shardings`` derived from the
-    partition rules, so large sharded params are *born* sharded — no host
-    materialization of the full model (essential for FSDP/TP configs).
-    Under ZeRO-1 the optimizer state is likewise born sharded over ``data``
-    (the overlay engages on the ``opt_state/...`` paths of the state tree).
-
-    Returns (state, state_shardings) — shardings are reused by the step jit
-    and by checkpoint restore.
-    """
+def _make_init_fn(model, optimizer, sample_inputs):
+    """The pure TrainState-constructing function shared by ``init_state``
+    (which jits it) and ``abstract_state`` (which only eval_shapes it)."""
 
     def init_fn(rng):
         from distributed_pytorch_example_tpu.train.tasks import (
@@ -95,6 +80,49 @@ def init_state(
             rng=rng_state,
         )
 
+    return init_fn
+
+
+def abstract_state(
+    model,
+    optimizer: optax.GradientTransformation,
+    sample_inputs: Any,
+) -> Any:
+    """ShapeDtypeStruct TrainState — ``eval_shape`` only, ZERO compiles.
+
+    graft-plan's entry point (analysis/planner.py): candidate plans are
+    scored from a trace of the step over this abstract state, so the
+    planner never touches a backend. ``sample_inputs`` may itself be
+    abstract (ShapeDtypeStructs).
+    """
+    # the sample goes through eval_shape as an ARGUMENT (not a closure
+    # capture) so ShapeDtypeStruct samples are abstracted like any tracer
+    return jax.eval_shape(
+        lambda rng, sample: _make_init_fn(model, optimizer, sample)(rng),
+        jax.random.key(0),
+        sample_inputs,
+    )
+
+
+def init_state(
+    model,
+    optimizer: optax.GradientTransformation,
+    sample_inputs: Any,
+    rng: jax.Array,
+    partitioner: Optional[Partitioner] = None,
+) -> Tuple[TrainState, Any]:
+    """Create a TrainState, placed per the partitioner's rules.
+
+    Initialization runs under jit with ``out_shardings`` derived from the
+    partition rules, so large sharded params are *born* sharded — no host
+    materialization of the full model (essential for FSDP/TP configs).
+    Under ZeRO-1 the optimizer state is likewise born sharded over ``data``
+    (the overlay engages on the ``opt_state/...`` paths of the state tree).
+
+    Returns (state, state_shardings) — shardings are reused by the step jit
+    and by checkpoint restore.
+    """
+    init_fn = _make_init_fn(model, optimizer, sample_inputs)
     if partitioner is None:
         return jax.jit(init_fn)(rng), None
     shapes = jax.eval_shape(init_fn, rng)
@@ -253,7 +281,11 @@ def build_train_step(
         from jax.sharding import PartitionSpec as P
 
         mesh = partitioner.mesh
-        dsize = mesh.shape.get("data", 1)
+        # every axis name and spec below comes off the partitioner (i.e.
+        # the PlanSpec lowering that built it) — the plan-overlay lint rule
+        # keeps hand-written axis placements out of this module
+        axis = partitioner.grad_sync_axis()
+        dsize = mesh.shape.get(axis, 1)
         if zero1:
             dims = partitioner.zero1_dims(params)
         else:
@@ -295,11 +327,11 @@ def build_train_step(
                 leaf_idx[0] += 1
                 if dim is not None:
                     g = wirelib.wire_psum_scatter(
-                        g, "data", scatter_dimension=dim, config=wire,
+                        g, axis, scatter_dimension=dim, config=wire,
                         key=key,
                     )
                 else:
-                    g = wirelib.wire_psum(g, "data", config=wire, key=key)
+                    g = wirelib.wire_psum(g, axis, config=wire, key=key)
                 return g * scale
 
             grads = jax.tree_util.tree_map(
@@ -309,29 +341,28 @@ def build_train_step(
             # shard sizes by the sampler's padding contract — same
             # reduction the replicated path's global mean computes)
             metrics = jax.tree_util.tree_map(
-                lambda m: jax.lax.pmean(m.astype(jnp.float32), "data"),
+                lambda m: jax.lax.pmean(m.astype(jnp.float32), axis),
                 metrics,
             )
-            new_ms = _pmean_inexact(new_ms, "data")
+            new_ms = _pmean_inexact(new_ms, axis)
             return grads, metrics, new_ms
 
-        def grad_out_spec(dim, g):
-            if dim is None:
-                return P()
-            entries: list = [None] * g.ndim
-            entries[dim] = "data"
-            return P(*entries)
-
         grad_out_specs = jax.tree_util.tree_map(
-            grad_out_spec, dims, params, is_leaf=is_dim_leaf
+            lambda dim, g: partitioner.grad_scatter_spec(dim, g.ndim),
+            dims, params, is_leaf=is_dim_leaf,
         )
         shard_ids = jnp.arange(max(dsize, 1), dtype=jnp.int32)
         mapped = jax_compat.shard_map(
             body,
             mesh,
-            in_specs=(P(), P(), P(("data",)), P("data"), P()),
+            in_specs=(
+                P(), P(),
+                partitioner.manual_batch_spec(),
+                partitioner.manual_axis_spec(),
+                P(),
+            ),
             out_specs=(grad_out_specs, P(), P()),
-            axis_names={"data"},
+            axis_names={axis},
         )
         return mapped(params, model_state, batch, shard_ids, rng)
 

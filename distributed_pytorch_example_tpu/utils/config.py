@@ -53,6 +53,13 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     parser.add_argument("--log-every", type=int, default=10,
                         help="batches between rank-0 progress logs "
                         "(reference train.py:144)")
+    parser.add_argument("--auto-mesh", action="store_true",
+                        help="graft-plan: pick the mesh + partitioner by "
+                        "ranking legal PlanSpecs through the static "
+                        "three-tier oracle (analysis/planner.py) instead "
+                        "of the --mesh-*/--zero1/--wire flags; searches "
+                        "at global batch = --batch-size x device count. "
+                        "DPX_HBM_LIMIT gates would-OOM plans pre-compile")
     parser.add_argument("--mesh-data", type=int, default=-1)
     parser.add_argument("--mesh-fsdp", type=int, default=1)
     parser.add_argument("--mesh-tensor", type=int, default=1)

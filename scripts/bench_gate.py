@@ -32,7 +32,10 @@ budget file is a committed artifact like ``BENCH_r*.json`` and goes stale
 the same way: after a deliberate sharding/schedule change, refresh it
 with ``graft_lint.py --write-budgets`` in the same commit — a stale
 budget file turns every later sweep into noise (spurious improvements or
-violations that belong to the earlier change).
+violations that belong to the earlier change). The committed planner
+rankings (``analysis/plans.json``, graft-plan) go stale the same way;
+this gate emits a non-fatal WARNING when they skew from the budgets or
+the runtime jax (refresh: ``scripts/plan_search.py --write-plans``).
 """
 
 from __future__ import annotations
@@ -171,6 +174,22 @@ def main() -> int:
             if diffs:
                 line += f"  CONFIG CHANGED {diffs} — delta not comparable"
         report.append(line)
+
+    # graft-plan advisory (warn, never fail — mirrors the jax-version-skew
+    # demotion of the comm budgets): a stale analysis/plans.json means the
+    # committed --auto-mesh rankings were computed against a collective
+    # schedule that no longer matches what this bench run compiled
+    try:
+        sys.path.insert(0, root)
+        from distributed_pytorch_example_tpu.analysis import planner
+
+        note = planner.plans_staleness()
+        if note:
+            print(f"bench_gate: WARNING (plans.json stale): {note}",
+                  file=sys.stderr)
+    except Exception as e:  # advisory only: never block the gate
+        print(f"bench_gate: plans.json staleness check skipped ({e})",
+              file=sys.stderr)
 
     header = f"bench_gate: current vs {os.path.basename(prev_path)}"
     if noise_models:

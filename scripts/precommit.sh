@@ -4,8 +4,10 @@
 # Runs every AST lint fixture plus the shipped-clean gates (the real
 # serving/train modules must carry zero findings — including the
 # wire-raw-collective rule pinning train/step.py's gradient sync to the
-# parallel/wire.py dispatch) without initializing a JAX backend, so it
-# is safe on any box — laptop, CI, or the TPU host.
+# parallel/wire.py dispatch, and the plan-overlay rule pinning
+# parallel/api.py + train/step.py shardings to the PlanSpec lowering)
+# plus the backend-free graft-plan planner units, without initializing a
+# JAX backend, so it is safe on any box — laptop, CI, or the TPU host.
 #
 #   ./scripts/precommit.sh
 #
@@ -13,4 +15,4 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest tests/test_graft_lint.py \
-    -m lint -q -p no:cacheprovider
+    tests/test_planner.py -m lint -q -p no:cacheprovider

@@ -113,7 +113,41 @@ def build_model(args):
     )["params"]
 
     partitioner = None
-    if args.mesh:
+    if args.auto_mesh:
+        # graft-plan: rank the serve plan space through the static oracle
+        # (prefill and decode scored separately; one engine runs both, so
+        # the pick minimizes the summed program cost) — zero compiles
+        import sys
+
+        from distributed_pytorch_example_tpu.analysis import (
+            envelope,
+            planner,
+        )
+        from distributed_pytorch_example_tpu.serving import InferenceEngine
+
+        probe = InferenceEngine(
+            model, params, num_slots=args.slots,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p,
+        )
+        plan, cost, _ranked = planner.pick_serve_plan(
+            probe, hbm_limit=envelope.hbm_limit_from_env(),
+            log=lambda m: print(m, file=sys.stderr),
+        )
+        if plan is None:
+            raise ValueError(
+                "--auto-mesh: no plan feasible for both prefill and decode"
+            )
+        print(
+            f"serve: --auto-mesh picked {plan.name()} "
+            f"(prefill+decode cost {cost:.4f} ms)",
+            file=sys.stderr,
+        )
+        args._auto_mesh_plan = plan.name()
+        partitioner = plan.lower()
+    elif args.mesh:
+        # --mesh lowers through PlanSpec too: transformer_partitioner is
+        # the PlanSpec(family="transformer") lowering (parallel/plan.py)
         from distributed_pytorch_example_tpu.parallel.partition import (
             transformer_partitioner,
         )
@@ -243,6 +277,8 @@ def _config_dict(args):
         "temperature": args.temperature, "top_k": args.top_k,
         "top_p": args.top_p, "seed": args.seed,
         **({"mesh": args.mesh} if args.mesh else {}),
+        **({"auto_mesh": getattr(args, "_auto_mesh_plan", None)}
+           if getattr(args, "_auto_mesh_plan", None) else {}),
         **({"chaos": args.chaos} if args.chaos else {}),
         **({"sessions": args.sessions} if args.sessions else {}),
         **({"replicas": args.replicas} if args.replicas > 1 else {}),
@@ -364,6 +400,12 @@ def main() -> int:
     parser.add_argument("--mesh", default="",
                         help="serve sharded, e.g. data=2,fsdp=2,tensor=2 "
                         "(axes product must equal the device count)")
+    parser.add_argument("--auto-mesh", action="store_true",
+                        help="graft-plan: pick the serving mesh via the "
+                        "static three-tier oracle (prefill and decode "
+                        "scored separately, best summed cost wins); "
+                        "replaces --mesh. DPX_HBM_LIMIT gates would-OOM "
+                        "plans pre-compile")
     parser.add_argument("--trace", default=None, metavar="PATH",
                         help="write per-request Chrome trace spans here")
     parser.add_argument("--replicas", type=int, default=1,
@@ -389,6 +431,8 @@ def main() -> int:
         parser.error("--replicas must be >= 1")
     if args.max_blocks * args.block_size > args.max_len:
         parser.error("--max-blocks * --block-size must be <= --max-len")
+    if args.auto_mesh and args.mesh:
+        parser.error("--auto-mesh replaces --mesh; drop one")
 
     from distributed_pytorch_example_tpu.telemetry.trace import TraceWriter
 

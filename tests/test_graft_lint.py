@@ -444,6 +444,70 @@ def test_wire_raw_collective_scope_suppression_and_lookalikes():
 
 
 @pytest.mark.lint
+def test_plan_overlay_fires_on_literal_specs():
+    # graft-plan: a string-literal PartitionSpec in the shipped sharding
+    # surfaces is an overlay the static planner cannot score
+    src = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "def rules(mesh):\n"
+        "    a = P('data', None)\n"
+        "    b = PartitionSpec(None, 'tensor')\n"
+        "    c = P(('data', 'fsdp'), None)\n"
+        "    d = P([None, 'tensor'])\n"
+        "    return a, b, c, d\n"
+    )
+    findings = pylint_rules.lint_source("parallel/api.py", src)
+    assert _rules(findings) == ["plan-overlay"] * 4
+    assert "PlanSpec" in findings[0].message
+    # same scope rule for the step module
+    step = pylint_rules.lint_source(
+        "train/step.py", "def f():\n    return P('data')\n"
+    )
+    assert _rules(step) == ["plan-overlay"]
+
+
+@pytest.mark.lint
+def test_plan_overlay_dynamic_construction_passes():
+    # the sanctioned pattern: specs built from the plan's mesh axes, not
+    # hard-coded axis strings — P(), P(*entries), P(axis_var)
+    ok = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "def rules(entries, axis):\n"
+        "    a = P()\n"
+        "    b = P(*entries)\n"
+        "    c = P(axis, None)\n"
+        "    d = P(tuple(entries), None)\n"
+        "    return a, b, c, d\n"
+    )
+    assert pylint_rules.lint_source("parallel/api.py", ok) == []
+
+
+@pytest.mark.lint
+def test_plan_overlay_scope_and_suppression():
+    src = "def f():\n    return P('data')\n"
+    # partition.py / plan.py themselves NAME the axes — they are the
+    # lowering, not an overlay; only api.py and step.py are in scope
+    assert pylint_rules.lint_source("parallel/partition.py", src) == []
+    assert pylint_rules.lint_source("parallel/plan.py", src) == []
+    assert pylint_rules.lint_source("models/gpt2.py", src) == []
+    supp = "def f():\n    return P('data')  # graft-lint: plan-overlay\n"
+    assert pylint_rules.lint_source("parallel/api.py", supp) == []
+
+
+@pytest.mark.lint
+def test_plan_overlay_real_modules_lint_clean():
+    # the acceptance gate: the shipped api.py and step.py lower every
+    # sharding through PlanSpec — no literal overlays remain
+    for rel in (("parallel", "api.py"), ("train", "step.py")):
+        path = os.path.join(
+            REPO_ROOT, "distributed_pytorch_example_tpu", *rel
+        )
+        with open(path) as fh:
+            src = fh.read()
+        assert pylint_rules.lint_source("/".join(rel), src) == [], rel
+
+
+@pytest.mark.lint
 def test_fleet_real_modules_lint_clean():
     # the acceptance gate: the shipped fleet/router layers carry a
     # timeout on every blocking wait, as committed

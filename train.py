@@ -106,6 +106,56 @@ def build_task(args, model):
     return dpx_train.CausalLMTask()
 
 
+def pick_auto_plan(args, parser, model, task, train_ds, global_batch):
+    """graft-plan ``--auto-mesh``: rank legal PlanSpecs through the static
+    three-tier oracle and lower the winner (zero XLA compiles).
+
+    The abstract batch is derived from the dataset's own element spec, so
+    the traced program is exactly the one ``Trainer.fit`` will compile.
+    Returns ``(mesh, partitioner, PlanScore)``.
+    """
+    import jax
+
+    from distributed_pytorch_example_tpu.analysis import envelope, planner
+    from distributed_pytorch_example_tpu.train.optimizers import make_optimizer
+
+    if (args.mesh_fsdp, args.mesh_tensor, args.mesh_sequence,
+            args.mesh_expert) != (1, 1, 1, 1) or args.mesh_pipe not in (0, 1):
+        parser.error("--auto-mesh replaces the --mesh-* flags; drop them")
+    if args.zero1 or args.wire != "none":
+        parser.error("--auto-mesh searches the zero1/wire knobs itself; "
+                     "drop --zero1/--wire")
+    element = train_ds[0]
+    batch = {
+        k: jax.ShapeDtypeStruct((global_batch,) + tuple(v.shape), v.dtype)
+        for k, v in element.items()
+    }
+    sample = batch["tokens"] if "tokens" in batch else next(iter(batch.values()))
+    # state shapes only — the schedule length never changes the plan space
+    optimizer = make_optimizer(
+        args.optimizer, args.lr, schedule=args.schedule,
+        warmup_steps=args.warmup_steps, total_steps=1,
+        weight_decay=args.weight_decay, grad_clip_norm=args.grad_clip,
+        every_k=args.grad_accum,
+    )
+    lm = args.model.startswith(("bert", "gpt", "llama"))
+    best, scores = planner.pick_train_plan(
+        model, task, optimizer, sample, batch,
+        kind="lm" if lm else "image",
+        program=f"train/{args.model}",
+        hbm_limit=envelope.hbm_limit_from_env(),
+        wire_block=args.wire_block,
+        log=logger.info,
+    )
+    if best is None:
+        reasons = "; ".join(
+            f"{s.plan.name()}: {s.reason}" for s in scores[:5]
+        )
+        parser.error(f"--auto-mesh found no feasible plan ({reasons})")
+    mesh = dpx.runtime.make_mesh(best.plan.mesh)
+    return mesh, best.plan.lower(mesh=mesh), best
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__)
     dpx.utils.add_reference_args(parser)
@@ -284,7 +334,19 @@ def main():
     task = build_task(args, model)
 
     pipelined = args.mesh_pipe not in (0, 1)
-    if args.partition == "fsdp" and not pipelined:
+    if args.auto_mesh:
+        # graft-plan: the planner picks mesh AND partitioner; the chosen
+        # PlanSpec carries its own zero1/wire knobs
+        mesh, partitioner, picked = pick_auto_plan(
+            args, parser, model, task, train_ds, global_batch
+        )
+        logger.info(
+            "graft-plan --auto-mesh picked %s (tier %d, cost %.4f ms, "
+            "%d wire bytes)",
+            picked.plan.name(), picked.tier, picked.cost_ms(),
+            picked.comm_bytes,
+        )
+    elif args.partition == "fsdp" and not pipelined:
         if args.zero1:
             parser.error("--zero1 is redundant under --partition fsdp "
                          "(FSDP already shards optimizer state with the "
@@ -308,13 +370,15 @@ def main():
             mesh, dp_shard_opt_state=args.zero1
         )
     # graft-wire collective compression: carried by the partitioner so the
-    # step, budgets, and telemetry all read one policy object
-    partitioner.wire = dpx.parallel.WireConfig(
-        compress=args.wire,
-        block_size=args.wire_block,
-        stochastic_rounding=args.wire_stochastic,
-        param_gather=args.wire_param_gather,
-    )
+    # step, budgets, and telemetry all read one policy object (--auto-mesh
+    # plans already lowered their own wire policy)
+    if not args.auto_mesh:
+        partitioner.wire = dpx.parallel.WireConfig(
+            compress=args.wire,
+            block_size=args.wire_block,
+            stochastic_rounding=args.wire_stochastic,
+            param_gather=args.wire_param_gather,
+        )
 
     train_loader = dpx.data.DeviceLoader(
         train_ds, global_batch, mesh=mesh, shuffle=True, seed=args.seed
