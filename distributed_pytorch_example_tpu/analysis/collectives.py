@@ -146,10 +146,16 @@ def parse_collective_dtypes(hlo_text: str) -> Dict[str, Dict[str, int]]:
 # config to the backward mode it claims to exercise.
 SCHEDULE_MARKERS = ("1f1b_stash_apply", "1f1b_recompute_apply")
 
+# Serving-implementation markers, same mechanism: the paged decode
+# attention dispatch (models/transformer.py ``_paged_step``) stamps
+# ``paged_decode_fused`` so the serve/decode budget entry can pin the
+# fused-dispatch path (vs silently re-materializing the gathered cache).
+SERVE_MARKERS = ("paged_decode_fused",)
+
 
 def parse_markers(hlo_text: str) -> Dict[str, bool]:
-    """Presence of each ``SCHEDULE_MARKERS`` name in a compiled module."""
-    return {m: m in hlo_text for m in SCHEDULE_MARKERS}
+    """Presence of each schedule/serve marker name in a compiled module."""
+    return {m: m in hlo_text for m in SCHEDULE_MARKERS + SERVE_MARKERS}
 
 
 def compile_case(case) -> Tuple[object, object]:
@@ -309,6 +315,25 @@ def compare_budgets(
                     "stage recompute (~4 forward-units per cycle instead "
                     "of ~3) — no byte budget moves, only this signature "
                     "catches it"
+                ),
+                config=config,
+            ))
+    if signature == "paged-decode-fused":
+        mk = markers or {}
+        if not mk.get("paged_decode_fused", False):
+            violations.append(Finding(
+                rule="comm-paged-decode-signature",
+                where="paged_decode_fused",
+                message=(
+                    "serve/decode program compiled WITHOUT the fused "
+                    "paged-decode marker: the decode step is not routing "
+                    "attention through the paged dispatch "
+                    "(models/transformer.py _paged_step lost the "
+                    "named scope, or the serve program stopped using the "
+                    "paged cache) — no byte budget moves when the gather "
+                    "path re-materializes the cache, only this signature "
+                    "catches it; keep the scope name and "
+                    "analysis/collectives.py SERVE_MARKERS in sync"
                 ),
                 config=config,
             ))

@@ -1,6 +1,6 @@
 """Repo-specific Python AST lints (no jax import, no backend).
 
-Eleven rules, each a distilled past-regression class:
+Twelve rules, each a distilled past-regression class:
 
 - ``host-sync``: ``.item()`` / ``np.asarray`` / ``jax.device_get`` inside
   TRACED-SCOPE sources (``ops/``, ``models/``, ``parallel/``,
@@ -98,6 +98,20 @@ Eleven rules, each a distilled past-regression class:
   built from the plan's mesh axes — is the sanctioned pattern; only
   literal axis strings (bare or inside tuple/list literals) fire.
 
+- ``decode-gather``: inside ``serving/`` or ``models/``, a function that
+  touches the paged KV pool (an identifier starting with ``pages_``) and
+  calls ``jnp.take(...)`` or ``lax.dynamic_update_slice(...)`` WITHOUT
+  also dispatching through ``paged_decode_attention`` /
+  ``paged_flash_decode``. Gather-materializing the paged cache (or
+  re-growing an unrolled per-block write loop) in serve-reachable jitted
+  code is exactly the per-token cost class the fused Pallas flash-decode
+  kernel (ops/pallas/paged_attention.py) removed — the ``.at[].set``
+  scatter write and the fused dispatch are the sanctioned pair, and the
+  XLA gather fallback lives ONLY inside ``ops/pallas/paged_attention.py``
+  (out of scope), bit-exact behind the kernel gate. The
+  ``paged-decode-fused`` comm-budget signature catches the same
+  regression after compile; this rule catches it at the source.
+
 Scope is static and name-based, not a whole-program call graph — the
 cheap 99% of the check. Deliberate exceptions carry a
 ``# graft-lint: ok`` (all rules) or ``# graft-lint: <rule>`` comment on
@@ -135,6 +149,10 @@ WIRE_RAW_SCOPE = ("train/step.py",)
 # lowering (parallel/plan.py) — a string-literal PartitionSpec in either
 # module is an ad-hoc overlay the static planner cannot score
 PLAN_OVERLAY_SCOPE = ("parallel/api.py", "train/step.py")
+# decode-gather pins serve-reachable paged-KV code to the fused-kernel
+# dispatch (ops/pallas/paged_attention.py) — the gather fallback itself
+# lives in that module, deliberately OUTSIDE this scope
+DECODE_GATHER_SCOPE = ("serving/", "models/")
 
 _ACCUM_CTORS = ("zeros", "zeros_like", "full", "empty")
 
@@ -496,6 +514,62 @@ def _fleet_unbounded_wait_findings(
     return [flagged[k] for k in sorted(flagged)]
 
 
+_DECODE_GATHER_CALLS = ("take", "dynamic_update_slice")
+_PAGED_DISPATCH = ("paged_decode_attention", "paged_flash_decode")
+
+
+def _decode_gather_findings(
+    tree: ast.Module, relpath: str, supp: Dict[int, Set[str]]
+) -> List[Finding]:
+    """Gather/unrolled-write KV materialization beside the paged pool
+    without the fused-kernel dispatch (module docstring)."""
+
+    def idents(node: ast.AST):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                yield sub.id
+            elif isinstance(sub, ast.Attribute):
+                yield sub.attr
+
+    flagged: Dict[int, Finding] = {}  # keyed by line: nesting dedup
+    for func in ast.walk(tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not any(name.startswith("pages_") for name in idents(func)):
+            continue  # not a paged-pool function
+        calls = [
+            node for node in ast.walk(func) if isinstance(node, ast.Call)
+        ]
+
+        def call_name(node: ast.Call) -> Optional[str]:
+            fn = node.func
+            return fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+
+        if any(call_name(c) in _PAGED_DISPATCH for c in calls):
+            continue  # routes through the fused kernel: sanctioned
+        for node in calls:
+            if call_name(node) not in _DECODE_GATHER_CALLS:
+                continue
+            if _suppressed(supp, node.lineno, "decode-gather"):
+                continue
+            flagged.setdefault(node.lineno, Finding(
+                rule="decode-gather",
+                where=f"{relpath}:{node.lineno}",
+                message=(
+                    f"{call_name(node)}(...) in a paged-KV function that "
+                    "never dispatches paged_decode_attention: gather-"
+                    "materializing the block pool (or unrolling per-block "
+                    "writes) in serve-reachable jitted code re-grows the "
+                    "per-token decode cost the fused Pallas kernel "
+                    "removed — write via .at[].set scatter and attend "
+                    "through ops/pallas/paged_attention.py"
+                ),
+            ))
+    return [flagged[k] for k in sorted(flagged)]
+
+
 def lint_source(relpath: str, source: str) -> List[Finding]:
     """All AST findings for one package source file.
 
@@ -699,6 +773,8 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
         findings.extend(_fleet_unbounded_wait_findings(tree, relpath, supp))
     if _in_scope(relpath, PLAN_OVERLAY_SCOPE):
         findings.extend(_plan_overlay_findings(tree, relpath, supp))
+    if _in_scope(relpath, DECODE_GATHER_SCOPE):
+        findings.extend(_decode_gather_findings(tree, relpath, supp))
     return findings
 
 

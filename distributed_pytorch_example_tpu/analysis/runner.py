@@ -401,10 +401,20 @@ def audit_serve(
                 f"{name}: does not compile here ({record['error']})"
             )
             continue
+        text = compiled.as_text()
         record = {
             "mesh": {k: int(v) for k, v in dict(case.mesh.shape).items()},
-            "collectives": coll.parse_collectives(compiled.as_text()),
+            "collectives": coll.parse_collectives(text),
         }
+        if name == "serve/decode":
+            # structural contract: decode attention must go through the
+            # fused paged dispatch (its named scope survives into the
+            # compiled module) — a silent fall-back to gathering the
+            # whole cache moves no collective bytes, only this signature
+            record["signature"] = "paged-decode-fused"
+        markers = coll.parse_markers(text)
+        if any(markers.values()):
+            record["markers"] = markers
         result.records[name] = record
         result.configs_audited += 1
         log(f"graft_lint: {name} compiled; "
@@ -433,6 +443,10 @@ def audit_serve(
                 v, n = coll.compare_budgets(
                     committed["collectives"], record["collectives"],
                     byte_tolerance=byte_tolerance, config=name,
+                    signature=committed.get(
+                        "signature", record.get("signature")
+                    ),
+                    markers=record.get("markers"),
                 )
                 if skew is not None:
                     result.notes.extend(

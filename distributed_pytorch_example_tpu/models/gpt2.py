@@ -57,6 +57,10 @@ class GPT2(nn.Module):
     paged_num_blocks: int = 0
     paged_block_size: int = 16
     paged_max_blocks: int = 0
+    # speculative-verify mode: seq>1 apply() calls score drafted tokens at
+    # positions row_lens..row_lens+seq-1 instead of prefilling fresh rows
+    # (serving/engine.py clones the serve model with this set).
+    paged_verify: bool = False
     # "full": return (B, S, V) logits. "hidden": return the final hidden
     # states instead, for the fused chunked-CE loss (train/tasks.py pairs
     # it with ``head_params``) — the f32 logits tensor never materializes.
@@ -217,6 +221,7 @@ class GPT2(nn.Module):
                 paged_num_blocks=self.paged_num_blocks,
                 paged_block_size=self.paged_block_size,
                 paged_max_blocks=self.paged_max_blocks,
+                paged_verify=self.paged_verify,
                 remat=self.remat,
                 moe_experts=self.moe_experts,
                 moe_every=self.moe_every,

@@ -84,6 +84,7 @@ class LlamaBlock(nn.Module):
     paged_num_blocks: int = 0  # >0: paged KV cache (serving/engine.py)
     paged_block_size: int = 16
     paged_max_blocks: int = 0
+    paged_verify: bool = False  # seq>1 = speculative verify chunk
     moe_experts: int = 0  # >0: Mixtral-style SwiGLU-expert MoE MLP
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -106,6 +107,7 @@ class LlamaBlock(nn.Module):
             paged_num_blocks=self.paged_num_blocks,
             paged_block_size=self.paged_block_size,
             paged_max_blocks=self.paged_max_blocks,
+            paged_verify=self.paged_verify,
             name="attn",
         )
         if self.moe_experts:
@@ -151,6 +153,7 @@ class Llama(nn.Module):
     paged_num_blocks: int = 0  # >0: paged KV cache (serving/engine.py)
     paged_block_size: int = 16
     paged_max_blocks: int = 0
+    paged_verify: bool = False  # seq>1 = speculative verify chunk
     remat: bool = False
     pipe_axis: Optional[str] = None  # mesh axis for pipeline stages (PP)
     pipe_microbatches: int = 0  # 0 = auto
@@ -285,6 +288,7 @@ class Llama(nn.Module):
                 paged_num_blocks=self.paged_num_blocks,
                 paged_block_size=self.paged_block_size,
                 paged_max_blocks=self.paged_max_blocks,
+                paged_verify=self.paged_verify,
                 moe_experts=self.moe_experts if is_moe else 0,
                 moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,

@@ -29,6 +29,9 @@ from distributed_pytorch_example_tpu.ops.attention import (
     dot_product_attention,
     fused_layout_eligible,
 )
+from distributed_pytorch_example_tpu.ops.pallas.paged_attention import (
+    paged_decode_attention,
+)
 
 
 class _DenseParams(nn.Module):
@@ -99,6 +102,12 @@ class MultiHeadAttention(nn.Module):
     paged_num_blocks: int = 0
     paged_block_size: int = 16
     paged_max_blocks: int = 0
+    # speculative-verify mode (serving/engine.py): seq > 1 calls are a
+    # multi-token DECODE chunk (the target model scoring drafted tokens
+    # at positions row_lens..row_lens+seq-1) instead of a fresh-row
+    # prefill. Static, so the verify program compiles separately from
+    # the prefill program (the engine clones the model with this set).
+    paged_verify: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None, *, kv_mask=None, train: bool = False):
@@ -312,11 +321,10 @@ class MultiHeadAttention(nn.Module):
         and lengths are OWNED BY THE HOST scheduler: the engine rewrites
         them between steps (insertion/eviction), so this method never
         updates them. Static shape split: ``seq > 1`` is the bucketed
-        prefill program, ``seq == 1`` the one-token-per-slot decode
-        program — together the two compiled programs of the engine.
+        prefill program (or, under ``paged_verify``, the speculative
+        verify program), ``seq == 1`` the one-token-per-slot decode
+        program — together the compiled programs of the engine.
         """
-        from jax import lax
-
         nb, bs = self.paged_num_blocks, self.paged_block_size
         mb = self.paged_max_blocks
         if nb < 2 or bs < 1 or mb < 1:
@@ -352,14 +360,15 @@ class MultiHeadAttention(nn.Module):
             q = rope(q, positions=positions, theta=self.rope_theta)
             k = rope(k, positions=positions, theta=self.rope_theta)
 
-        if seq > 1:
+        if seq > 1 and not self.paged_verify:
             # ---- prefill: fresh rows (row_lens == 0 by engine contract),
             # bucket-padded to a multiple of the block size. Attention is
             # plain causal self-attention over this call's tokens (pad
             # tokens sit at later positions, so real logits never see
-            # them); K/V land in the rows' pool blocks via one
-            # dynamic_update_slice per (row, block) — unrolled, the
-            # bucket size is static.
+            # them); K/V land in the rows' pool blocks via ONE batched
+            # scatter over the (row, block) table entries, so XLA compile
+            # time no longer scales with the bucket's block count the way
+            # the old unrolled dynamic_update_slice loop did.
             if seq % bs:
                 raise ValueError(
                     f"prefill length {seq} must be a multiple of "
@@ -372,57 +381,50 @@ class MultiHeadAttention(nn.Module):
                     f"paged_max_blocks {mb}"
                 )
             kb = k.astype(pages_k.value.dtype).reshape(
-                batch, n_blk, bs, kv_heads, self.head_dim
+                batch * n_blk, bs, kv_heads, self.head_dim
             )
             vb = v.astype(pages_v.value.dtype).reshape(
-                batch, n_blk, bs, kv_heads, self.head_dim
+                batch * n_blk, bs, kv_heads, self.head_dim
             )
-            pk, pv = pages_k.value, pages_v.value
-            for b in range(batch):
-                for j in range(n_blk):
-                    pid = table.value[b, j]
-                    pk = lax.dynamic_update_slice(
-                        pk, kb[b, j][None], (pid, 0, 0, 0)
-                    )
-                    pv = lax.dynamic_update_slice(
-                        pv, vb[b, j][None], (pid, 0, 0, 0)
-                    )
-            pages_k.value, pages_v.value = pk, pv
+            block_ids = table.value[:, :n_blk].reshape(-1)  # (B * n_blk,)
+            pages_k.value = pages_k.value.at[block_ids].set(kb)
+            pages_v.value = pages_v.value.at[block_ids].set(vb)
             return dot_product_attention(
                 q, k, v, causal=True, use_flash=False,
             )
 
-        # ---- decode: one new token per row at position row_lens[b].
-        # One vectorized scatter into (block, offset) per row; inactive
-        # rows' tables are all-scratch, so their writes pile up on block
-        # (0, 0) and are never read by a live row.
-        pos = lens.value  # (B,)
-        block_idx = jnp.take_along_axis(
-            table.value, (pos // bs)[:, None], axis=1
-        )[:, 0]
-        off = pos % bs
+        # ---- decode (seq == 1) / speculative verify (seq > 1): token s of
+        # row b sits at absolute position positions[b, s]. One vectorized
+        # scatter into (block, offset) pairs; inactive rows' tables are
+        # all-scratch, so their writes pile up on block 0 and are never
+        # read by a live row. Verify chunks can run past a row's true
+        # length near the context limit — out-of-table block indices are
+        # routed to the scratch block explicitly (those queries' logits
+        # are discarded by the host-side acceptance loop).
+        blk_j = positions // bs  # (B, S)
+        block_idx = jnp.where(
+            blk_j < mb,
+            jnp.take_along_axis(table.value, jnp.minimum(blk_j, mb - 1), axis=1),
+            0,
+        )
+        off = positions % bs
         pages_k.value = pages_k.value.at[block_idx, off].set(
-            k[:, 0].astype(pages_k.value.dtype)
+            k.astype(pages_k.value.dtype)
         )
         pages_v.value = pages_v.value.at[block_idx, off].set(
-            v[:, 0].astype(pages_v.value.dtype)
+            v.astype(pages_v.value.dtype)
         )
-        # gather each row's blocks back into position order: gathered key
-        # j*bs + o is exactly the token at position j*bs + o, so the
-        # visibility mask is the same `key_pos <= position` predicate the
-        # contiguous path uses — numerics match token-for-token.
-        gk = jnp.take(pages_k.value, table.value, axis=0).reshape(
-            batch, mb * bs, kv_heads, self.head_dim
-        )
-        gv = jnp.take(pages_v.value, table.value, axis=0).reshape(
-            batch, mb * bs, kv_heads, self.head_dim
-        )
-        key_pos = jnp.arange(mb * bs)[None, None, None, :]
-        visible = key_pos <= pos[:, None, None, None]
-        return dot_product_attention(
-            q, gk, gv, mask=visible, causal=False,
-            use_flash=False,  # single-token queries: XLA path is right-sized
-        )
+        # pooled key j*bs + o is exactly the token at position j*bs + o,
+        # so visibility is the same `key_pos <= position` predicate the
+        # contiguous path uses — numerics match token-for-token. The
+        # fused Pallas kernel (ops/pallas/paged_attention.py) reads live
+        # blocks straight from the pool via the scalar-prefetched table;
+        # off-TPU the dispatcher's XLA fallback gathers the pool exactly
+        # like the historical decode path (bit-identical).
+        with jax.named_scope("paged_decode_fused"):
+            return paged_decode_attention(
+                q, pages_k.value, pages_v.value, table.value, positions
+            )
 
     def _ring_mesh(self, mask):
         """The active mesh when sequence parallelism should run, else None.
@@ -498,6 +500,7 @@ class TransformerBlock(nn.Module):
     paged_num_blocks: int = 0  # >0: paged KV cache (serving/engine.py)
     paged_block_size: int = 16
     paged_max_blocks: int = 0
+    paged_verify: bool = False  # seq>1 = speculative verify chunk
     moe_experts: int = 0  # >0: Mixture-of-Experts MLP with this many experts
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
@@ -518,6 +521,7 @@ class TransformerBlock(nn.Module):
             paged_num_blocks=self.paged_num_blocks,
             paged_block_size=self.paged_block_size,
             paged_max_blocks=self.paged_max_blocks,
+            paged_verify=self.paged_verify,
             name="attn",
         )
         if self.moe_experts:
@@ -576,6 +580,7 @@ class TransformerStack(nn.Module):
     paged_num_blocks: int = 0  # >0: paged KV cache (serving/engine.py)
     paged_block_size: int = 16
     paged_max_blocks: int = 0
+    paged_verify: bool = False  # seq>1 = speculative verify chunk
     remat: bool = False
     moe_experts: int = 0
     moe_every: int = 2  # MoE MLP on every Nth block (Switch uses 2)
@@ -608,6 +613,7 @@ class TransformerStack(nn.Module):
                 paged_num_blocks=self.paged_num_blocks,
                 paged_block_size=self.paged_block_size,
                 paged_max_blocks=self.paged_max_blocks,
+                paged_verify=self.paged_verify,
                 moe_experts=self.moe_experts if is_moe else 0,
                 moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,

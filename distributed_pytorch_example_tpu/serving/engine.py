@@ -10,6 +10,21 @@ variant per length bucket), so in-flight batching never recompiles:
   Inactive slots ride along pointed at the scratch block; their sampled
   tokens are discarded on the host.
 
+Speculative decoding (``spec_tokens=K`` + a draft model) swaps the
+decode boundary for two programs of the same fixed-slot shape:
+``_draft_propose_step`` (one scanned program greedily proposing K-1
+tokens per row from the draft's own pool) and ``_verify_step`` (the
+target scoring the K-token window in one bucketed call, via the model
+cloned with ``paged_verify=True``). Acceptance is EXACT-MATCH: the
+target samples its own token at every window position with the same
+position-folded rng the one-token path uses, and a drafted token is
+committed only when it equals the target's draw — so the emitted stream
+is bit-identical to non-speculative decoding at any temperature, and
+fleet journal replay / preemption-restart determinism hold by
+construction. Rejected positions leave garbage KV behind in both pools;
+it is never visible (attention masks by position) and the next boundary
+overwrites it.
+
 The paged pool lives in the model's flax ``cache`` collection
 (models/transformer.py ``_paged_step``); the engine owns the canonical
 cache pytree between calls and rewrites the ``page_table`` / ``row_lens``
@@ -50,6 +65,7 @@ from distributed_pytorch_example_tpu.serving.cache import (
 from distributed_pytorch_example_tpu.serving.sampling import (
     fold_keys,
     sample_rows,
+    sample_token_matrix,
 )
 from distributed_pytorch_example_tpu.serving.scheduler import (
     Request,
@@ -175,6 +191,84 @@ def _decode_step(model, params, cache, tokens, keys, positions, poison, *,
     return vars_["cache"], nxt, ok
 
 
+@partial(
+    jax.jit,
+    static_argnums=(0,),
+    static_argnames=("steps", "mesh", "batch_axes"),
+)
+def _draft_propose_step(model, params, cache, table, lens, tokens, *,
+                        steps, mesh=None, batch_axes=()):
+    """Greedily propose ``steps`` draft tokens per slot in ONE program.
+
+    A ``lax.scan`` of one-token decode calls against the DRAFT pool; the
+    scheduler-owned ``row_lens`` advance inside the scan (``lens + i``)
+    so iteration i writes draft KV at position ``lens + i`` — the host
+    never re-enters between drafted tokens, which is what makes a
+    speculative boundary two dispatches total instead of K. Proposals
+    are argmax regardless of the engine temperature: acceptance is an
+    exact match against the target's (possibly sampled) draw, so the
+    draft's own sampling never affects the output stream, only the
+    accept rate.
+
+    The caller passes ``steps`` = the full speculative window K even
+    though only K-1 proposals enter the verify window: the final
+    iteration exists to WRITE ``draft_{K-1}``'s KV at position
+    ``lens + K - 1``. Without it, a fully-accepted boundary (K committed
+    tokens) would leave a hole at that position in the draft pool and
+    the next boundary's first proposal would attend garbage, collapsing
+    the accept rate right after the windows that went best.
+    """
+    if mesh is not None:
+        cache = _constrain_paged_cache(cache, mesh, tuple(batch_axes))
+
+    def body(carry, i):
+        c, tok = carry
+        c = _with_tables(c, table, lens + i)
+        logits, vars_ = model.apply(
+            {"params": params, "cache": c}, tok[:, None], train=False,
+            mutable=["cache"],
+        )
+        nxt = jnp.argmax(
+            logits[:, -1].astype(jnp.float32), axis=-1
+        ).astype(jnp.int32)
+        return (vars_["cache"], nxt), nxt
+
+    (cache, _), drafted = lax.scan(body, (cache, tokens), jnp.arange(steps))
+    return cache, jnp.swapaxes(drafted, 0, 1)  # (slots, steps)
+
+
+@partial(
+    jax.jit,
+    static_argnums=(0,),
+    static_argnames=("temperature", "top_k", "top_p", "mesh", "batch_axes"),
+)
+def _verify_step(model, params, cache, tokens, keys, positions, poison, *,
+                 temperature, top_k, top_p, mesh=None, batch_axes=()):
+    """Score a (slots, K) window [last committed, draft_1..draft_{K-1}]
+    in one bucketed call over the fixed slot array.
+
+    ``model`` is the serve model cloned with ``paged_verify=True``, so
+    the multi-token call is a DECODE chunk (per-position causal masking
+    against the paged pool), not a prefill. ``positions[b]`` is the
+    absolute position of the first token to be sampled (= row_lens + 1);
+    window position i samples with ``fold_in(key, positions + i)`` —
+    bit-identical draws to i sequential one-token steps.
+    """
+    if mesh is not None:
+        cache = _constrain_paged_cache(cache, mesh, tuple(batch_axes))
+    logits, vars_ = model.apply(
+        {"params": params, "cache": cache}, tokens, train=False,
+        mutable=["cache"],
+    )
+    logits = logits.astype(jnp.float32)  # (slots, K, V)
+    logits = jnp.where(poison[:, None, None], jnp.float32(jnp.nan), logits)
+    ok = jnp.all(jnp.isfinite(logits), axis=(1, 2))
+    tgt = sample_token_matrix(
+        logits, keys, positions, temperature, top_k, top_p
+    )
+    return vars_["cache"], tgt, ok
+
+
 def _percentiles(samples: Sequence[float]) -> Dict[str, float]:
     if not samples:
         return {"p50": None, "p95": None, "p99": None}
@@ -214,6 +308,9 @@ class InferenceEngine:
         sleep=time.sleep,
         mode: str = "continuous",
         fetch_timeout_s: Optional[float] = None,
+        draft_model=None,
+        draft_params=None,
+        spec_tokens: int = 0,
     ):
         nb = int(getattr(model, "paged_num_blocks", 0))
         bs = int(getattr(model, "paged_block_size", 0))
@@ -276,17 +373,64 @@ class InferenceEngine:
                     f"block_size {bs} and <= max_len {max_len}"
                 )
 
+        # speculative decoding: a draft model proposes spec_tokens - 1
+        # tokens per boundary, the target verifies the window in one
+        # bucketed step. The draft gets its own pool (same geometry, so
+        # the scheduler's block tables address both).
+        self.spec_tokens = int(spec_tokens)
+        self.draft_model = draft_model
+        self.draft_params = draft_params
+        self._verify_model = None
+        self._draft_cache = None
+        if self.spec_tokens:
+            if self.spec_tokens < 2:
+                raise ValueError(
+                    f"spec_tokens must be >= 2 (got {self.spec_tokens}): "
+                    "1 drafted token is the non-speculative decode step"
+                )
+            if draft_model is None or draft_params is None:
+                raise ValueError(
+                    "spec_tokens > 0 needs draft_model and draft_params"
+                )
+            for field in (
+                "paged_num_blocks", "paged_block_size", "paged_max_blocks"
+            ):
+                got = int(getattr(draft_model, field, 0))
+                want = int(getattr(model, field))
+                if got != want:
+                    raise ValueError(
+                        f"draft model {field}={got} != target {want}: the "
+                        "draft pool must share the target's paged geometry "
+                        "so one scheduler table addresses both"
+                    )
+            if not getattr(draft_model, "decode", False):
+                raise ValueError("draft model must be built with decode=True")
+            self._verify_model = model.clone(paged_verify=True)
+            if partitioner is not None:
+                self.draft_params = partitioner.shard_tree(draft_params)
+
         with self._mesh_ctx():
             self._cache = model.init(
                 jax.random.key(0),
                 jnp.zeros((num_slots, 1), jnp.int32),
                 train=False,
             )["cache"]
+            if self.spec_tokens:
+                self._draft_cache = draft_model.init(
+                    jax.random.key(0),
+                    jnp.zeros((num_slots, 1), jnp.int32),
+                    train=False,
+                )["cache"]
         # per-slot device-side sampling state (host-written at boundaries)
         self._slot_keys = jax.vmap(jax.random.key)(
             jnp.zeros((num_slots,), jnp.uint32)
         )
         self._slot_tokens = np.zeros((num_slots,), np.int32)
+        # decode-side throughput / speculation accounting, reset per run
+        self._decode_time_s = 0.0
+        self._decode_tokens = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
 
     # -- plumbing ---------------------------------------------------------
 
@@ -297,12 +441,16 @@ class InferenceEngine:
             contextlib.nullcontext()
         )
 
+    def _mesh_kw(self) -> dict:
+        if self._mesh is not None:
+            return dict(mesh=self._mesh, batch_axes=self._batch_axes)
+        return {}
+
     def _static_kw(self) -> dict:
         kw = dict(
             temperature=self.temperature, top_k=self.top_k, top_p=self.top_p,
         )
-        if self._mesh is not None:
-            kw.update(mesh=self._mesh, batch_axes=self._batch_axes)
+        kw.update(self._mesh_kw())
         return kw
 
     def _bucket_for(self, prompt_len: int) -> int:
@@ -489,6 +637,21 @@ class InferenceEngine:
             )
         self._cache = _merge_pages(self._cache, out_cache)
         self._span(f"prefill:{req.rid}", t0)
+        if self.spec_tokens:
+            # the draft pool needs the prompt's KV too (same blocks, its
+            # own storage); the draft's sampled token is discarded — the
+            # TARGET's prefill token is the stream's first token
+            t0 = self._ts_us()
+            with self._mesh_ctx():
+                draft_cache, _tok, _ok = _prefill_step(
+                    self.draft_model, self.draft_params,
+                    _with_tables(self._draft_cache, table, lens),
+                    jnp.asarray(tokens), jax.random.key(req.seed),
+                    jnp.int32(plen), jnp.asarray(False),
+                    **self._static_kw(),
+                )
+            self._draft_cache = _merge_pages(self._draft_cache, draft_cache)
+            self._span(f"draft_prefill:{req.rid}", t0)
         now = self.clock()
         st.t_first = now
         st.token_times.append(now)
@@ -500,8 +663,20 @@ class InferenceEngine:
         return bool(ok)
 
     def _run_decode(self, sched: Scheduler) -> List[RequestState]:
-        """One fixed-slot decode step; returns the requests that finished
-        (done or evicted-with-error) at this boundary."""
+        """One fixed-slot decode boundary; returns the requests that
+        finished (done or evicted-with-error) at it. Dispatches to the
+        speculative path when a draft model is configured, so ``run()``,
+        ``serve_loop()`` and ``warmup()`` all inherit speculation."""
+        t_wall = self.clock()
+        if self.spec_tokens:
+            finished = self._run_decode_spec(sched)
+        else:
+            finished = self._run_decode_one(sched)
+        self._decode_time_s += max(self.clock() - t_wall, 0.0)
+        return finished
+
+    def _run_decode_one(self, sched: Scheduler) -> List[RequestState]:
+        """One token per slot — the non-speculative decode step."""
         active = sched.active()
         ns = self.config.num_slots
         table = np.full(
@@ -551,10 +726,116 @@ class InferenceEngine:
             st.generated.append(tok)
             st.token_times.append(now)
             self._slot_tokens[slot] = tok
+            self._decode_tokens += 1
             if (
                 (req.eos_id is not None and tok == req.eos_id)
                 or len(st.generated) >= req.max_new_tokens
             ):
+                sched.finish(st, "done", now=now)
+                self._span_request(st)
+                finished.append(st)
+        return finished
+
+    def _run_decode_spec(self, sched: Scheduler) -> List[RequestState]:
+        """One speculative boundary: draft K-1 tokens, verify the K-token
+        window in one bucketed target step, commit the longest drafted
+        prefix the target reproduces plus the target's own token at the
+        first mismatch — up to K committed tokens in two dispatches.
+
+        Acceptance runs on the host against the TARGET's sampled window
+        (``tgt[i]`` is the bit-exact token sequential decoding would have
+        drawn at position ``cached_len + 1 + i`` given the same prefix),
+        so committing ``tgt[:accept + 1]`` is literally replaying the
+        sequential stream — rejected drafts only cost the speculated
+        compute, never correctness.
+        """
+        active = sched.active()
+        ns = self.config.num_slots
+        K = self.spec_tokens
+        table = np.full(
+            (ns, self.config.max_blocks_per_slot), SCRATCH_BLOCK, np.int32
+        )
+        lens = np.zeros((ns,), np.int32)
+        positions = np.ones((ns,), np.int32)
+        poison = np.zeros((ns,), bool)
+        for slot, st in active:
+            table[slot] = sched.allocator.table_row(st.blocks)
+            lens[slot] = st.cached_len
+            positions[slot] = st.cached_len + 1
+            poison[slot] = chaos.poison_request(
+                st.request.rid, len(st.generated)
+            )
+        table_j = jnp.asarray(table)
+        lens_j = jnp.asarray(lens)
+        t0 = self._ts_us()
+        with self._mesh_ctx():
+            self._draft_cache, drafted = _draft_propose_step(
+                self.draft_model, self.draft_params,
+                _with_tables(self._draft_cache, table_j, lens_j),
+                table_j, lens_j, jnp.asarray(self._slot_tokens),
+                steps=K, **self._mesh_kw(),
+            )
+            drafted = self._fetch(
+                lambda: jax.device_get(drafted), "serve draft fetch"
+            )
+        self._span("draft_propose", t0)
+        # the K-th proposal exists only for its KV write (see
+        # _draft_propose_step); the verify window uses d_1 .. d_{K-1}
+        window = np.concatenate(
+            [
+                self._slot_tokens[:, None],
+                np.asarray(drafted, np.int32)[:, : K - 1],
+            ],
+            axis=1,
+        )  # (slots, K): [last committed, d_1 .. d_{K-1}]
+        t0 = self._ts_us()
+        with self._mesh_ctx():
+            out_cache, tgt, ok = _verify_step(
+                self._verify_model, self.params,
+                _with_tables(self._cache, table_j, lens_j),
+                jnp.asarray(window), self._slot_keys,
+                jnp.asarray(positions), jnp.asarray(poison),
+                **self._static_kw(),
+            )
+            tgt, ok = self._fetch(
+                lambda: jax.device_get((tgt, ok)), "serve verify fetch"
+            )
+        self._cache = out_cache
+        self._span("verify_step", t0)
+        now = self.clock()
+        finished: List[RequestState] = []
+        for slot, st in active:
+            req = st.request
+            if not bool(ok[slot]):
+                sched.finish(
+                    st, "error", now=now,
+                    error="nonfinite logits at generated token "
+                          f"{len(st.generated)}",
+                )
+                self._span_request(st)
+                finished.append(st)
+                continue
+            accept = 0
+            while (
+                accept < K - 1
+                and int(window[slot, accept + 1]) == int(tgt[slot, accept])
+            ):
+                accept += 1
+            self._spec_proposed += K - 1
+            self._spec_accepted += accept
+            done = False
+            for tok in (int(t) for t in tgt[slot, : accept + 1]):
+                st.generated.append(tok)
+                st.token_times.append(now)
+                self._slot_tokens[slot] = tok
+                self._decode_tokens += 1
+                if (
+                    (req.eos_id is not None and tok == req.eos_id)
+                    or len(st.generated) >= req.max_new_tokens
+                ):
+                    done = True
+                    break
+            if done:
                 sched.finish(st, "done", now=now)
                 self._span_request(st)
                 finished.append(st)
@@ -623,9 +904,12 @@ class InferenceEngine:
 
     def _grow_or_preempt(self, sched: Scheduler) -> None:
         """Grow each resident row's table at a decode boundary, preempting
-        the youngest resident until the growth fits."""
+        the youngest resident until the growth fits. A speculative
+        boundary writes KV up to ``spec_tokens`` positions ahead, so the
+        window's blocks must exist before dispatch."""
+        tokens = max(self.spec_tokens, 1)
         for _slot, st in list(sched.active()):
-            while st.status == "running" and not sched.grow(st):
+            while st.status == "running" and not sched.grow(st, tokens):
                 victim = sched.preempt_youngest()
                 if victim is None or victim is st:
                     break
@@ -641,6 +925,7 @@ class InferenceEngine:
         t_start = self.clock()
         decode_steps = 0
         occupied_rows = 0
+        self._reset_decode_counters()
 
         while True:
             now = self.clock()
@@ -723,6 +1008,7 @@ class InferenceEngine:
         """
         sched = Scheduler(self.config, mode=self.mode)
         step_idx = 0
+        self._reset_decode_counters()
 
         def _submit(req: Request) -> None:
             st = sched.submit(req, self.clock())
@@ -763,6 +1049,37 @@ class InferenceEngine:
             if on_tick is not None:
                 on_tick(sched, step_idx, rows)
 
+    def _reset_decode_counters(self) -> None:
+        self._decode_time_s = 0.0
+        self._decode_tokens = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+
+    def decode_metrics(self) -> Dict[str, Optional[float]]:
+        """Decode-side throughput since the last ``run()``/``serve_loop()``
+        start: wall time spent at decode boundaries (speculative or not),
+        tokens committed there (prefill tokens excluded), and the drafted
+        -token accept rate (None when speculation is off). Also how a
+        fleet (serving/router.py callers) aggregates per-replica decode
+        throughput — ``serve_loop`` never builds a ``_report``."""
+        return {
+            "decode_time_s": self._decode_time_s,
+            "decode_tokens": self._decode_tokens,
+            "decode_tokens_per_sec": (
+                self._decode_tokens / self._decode_time_s
+                if self._decode_time_s > 0 else 0.0
+            ),
+            "spec_accept_rate": (
+                self._spec_accepted / self._spec_proposed
+                if self._spec_proposed else None
+            ),
+            # raw counters so a fleet can pool accept rates across
+            # replicas (sum counts, divide once) instead of averaging
+            # per-replica ratios with mismatched weights
+            "spec_proposed": self._spec_proposed,
+            "spec_accepted": self._spec_accepted,
+        }
+
     def _report(self, states, sched, elapsed, decode_steps, occupied_rows):
         results = {}
         ttft, tpot = [], []
@@ -799,5 +1116,6 @@ class InferenceEngine:
             ),
             "ttft_ms": _percentiles(ttft),
             "tpot_ms": _percentiles(tpot),
+            **self.decode_metrics(),
         }
         return {"results": results, "metrics": metrics}

@@ -68,3 +68,34 @@ def fold_keys(keys: jax.Array, positions: jax.Array) -> jax.Array:
     """Per-row step keys: fold each row's absolute token position into its
     request key (see module docstring for the contract)."""
     return jax.vmap(jax.random.fold_in)(keys, positions)
+
+
+def sample_token_matrix(
+    logits: jax.Array,
+    keys: jax.Array,
+    positions: jax.Array,
+    temperature: float,
+    top_k: Optional[int],
+    top_p: Optional[float],
+) -> jax.Array:
+    """Sample a (B, S) token window from (B, S, V) logits.
+
+    Token (b, i) is drawn with ``fold_in(keys[b], positions[b] + i)`` —
+    the exact per-position folding the one-token decode path applies, so
+    a speculative verify window samples bit-identical tokens to S
+    sequential decode steps over the same logits. That identity is the
+    whole determinism story for speculative decoding: accept/reject
+    replays exactly under fleet journal replay and preemption restart.
+    """
+    batch, steps, vocab = logits.shape
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    pos = positions[:, None] + jnp.arange(steps)[None, :]  # (B, S)
+    step_keys = jax.vmap(
+        lambda key, row: jax.vmap(jax.random.fold_in, (None, 0))(key, row)
+    )(keys, pos)
+    flat = truncate_logits(logits, temperature, top_k, top_p)
+    toks = jax.vmap(jax.random.categorical)(
+        step_keys.reshape(batch * steps), flat.reshape(batch * steps, vocab)
+    )
+    return toks.reshape(batch, steps).astype(jnp.int32)

@@ -186,11 +186,17 @@ class Scheduler:
                 return slot
         return None
 
-    def grow(self, st: RequestState) -> bool:
-        """Ensure the block holding position ``cached_len`` exists before
-        the next decode write; allocate one block when crossing a block
-        boundary. False = the shard is out of blocks (caller preempts)."""
-        need = self.config.blocks_for(st.cached_len + 1)
+    def grow(self, st: RequestState, tokens: int = 1) -> bool:
+        """Ensure the blocks holding positions ``cached_len`` ..
+        ``cached_len + tokens - 1`` exist before the next decode write;
+        allocate blocks when crossing block boundaries. ``tokens > 1`` is
+        the speculative window (draft + verify write KV that far ahead).
+        The target is clamped to the request's own ceiling so speculation
+        never allocates blocks the request cannot use — writes past the
+        ceiling land on the scratch block by the page-table contract.
+        False = the shard is out of blocks (caller preempts)."""
+        ceiling = len(st.request.prompt) + st.request.max_new_tokens
+        need = self.config.blocks_for(min(st.cached_len + tokens, ceiling))
         while len(st.blocks) < need:
             got = self.allocator.alloc(
                 1, self.allocator.shard_of_slot(st.slot)

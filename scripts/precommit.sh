@@ -4,10 +4,13 @@
 # Runs every AST lint fixture plus the shipped-clean gates (the real
 # serving/train modules must carry zero findings — including the
 # wire-raw-collective rule pinning train/step.py's gradient sync to the
-# parallel/wire.py dispatch, and the plan-overlay rule pinning
-# parallel/api.py + train/step.py shardings to the PlanSpec lowering)
-# plus the backend-free graft-plan planner units, without initializing a
-# JAX backend, so it is safe on any box — laptop, CI, or the TPU host.
+# parallel/wire.py dispatch, the plan-overlay rule pinning
+# parallel/api.py + train/step.py shardings to the PlanSpec lowering,
+# and the decode-gather rule pinning serving//models/ paged-pool access
+# to the fused paged_decode_attention dispatch) plus the
+# paged-decode-fused budget-signature units and the backend-free
+# graft-plan planner units, without initializing a JAX backend, so it
+# is safe on any box — laptop, CI, or the TPU host.
 #
 #   ./scripts/precommit.sh
 #

@@ -169,6 +169,17 @@ def build_engines(args, trace, built, n):
     from distributed_pytorch_example_tpu.telemetry.trace import PrefixedTrace
 
     model, params, partitioner = built
+    spec = {}
+    if args.spec_tokens:
+        # self-speculation: the target drafts for itself. Zero accuracy
+        # risk (exact-match acceptance keeps output bit-identical either
+        # way) and the win is real whenever drafting a token is cheaper
+        # than a full decode boundary; a separately trained small draft
+        # drops into the same two kwargs.
+        spec = dict(
+            draft_model=model, draft_params=params,
+            spec_tokens=args.spec_tokens,
+        )
     engines = []
     for i in range(n):
         engines.append(InferenceEngine(
@@ -176,7 +187,7 @@ def build_engines(args, trace, built, n):
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, partitioner=partitioner,
             trace=PrefixedTrace(trace, f"r{i}") if n > 1 else trace,
-            mode=args.mode,
+            mode=args.mode, **spec,
         ))
     return engines
 
@@ -222,7 +233,22 @@ def run_fleet(args, trace, built, requests):
         )
         print(f"serve: fleet pass '{tag}' ({args.replicas} replicas)",
               file=sys.stderr)
-        return router.run(requests)
+        report = router.run(requests)
+        # fleet decode throughput: each worker thread runs serve_loop
+        # exactly once per pass, so per-engine counters cover the pass;
+        # rates pool by summed counts (not averaged per-replica ratios)
+        dm = [eng.decode_metrics() for eng in engines]
+        t = sum(d["decode_time_s"] for d in dm)
+        toks = sum(d["decode_tokens"] for d in dm)
+        prop = sum(d["spec_proposed"] for d in dm)
+        acc = sum(d["spec_accepted"] for d in dm)
+        report["metrics"].update(
+            decode_time_s=t,
+            decode_tokens=toks,
+            decode_tokens_per_sec=toks / t if t > 0 else 0.0,
+            spec_accept_rate=acc / prop if prop else None,
+        )
+        return report
 
     # XLA compile freezes replica heartbeats, so the fleet must be warm
     # before any router with a finite deadline sees it
@@ -282,6 +308,7 @@ def _config_dict(args):
         **({"chaos": args.chaos} if args.chaos else {}),
         **({"sessions": args.sessions} if args.sessions else {}),
         **({"replicas": args.replicas} if args.replicas > 1 else {}),
+        **({"spec_tokens": args.spec_tokens} if args.spec_tokens else {}),
     }
 
 
@@ -291,6 +318,8 @@ def emit_fleet_line(args, report, baseline) -> int:
     gate reads (per-replica occupancy, shed/replayed/redispatched,
     detection latency, and — when a chaos baseline ran —
     ``steady_state_ratio``)."""
+    import numpy as np
+
     for rid, r in sorted(report["results"].items()):
         print(json.dumps({
             "rid": rid, "status": r["status"], "replica": r["replica"],
@@ -330,6 +359,18 @@ def emit_fleet_line(args, report, baseline) -> int:
         "steady_per_row_ms_min": (
             round(m["steady_per_row_ms_min"], 3)
             if m["steady_per_row_ms_min"] is not None else None
+        ),
+        "decode_tokens_per_sec": round(m["decode_tokens_per_sec"], 2),
+        # fleet TPOT proxy: p99 of full-occupancy per-row boundary cost
+        # across replicas (the router's steady-state samples)
+        "tpot_p99_ms": (
+            round(
+                float(np.percentile(m["steady_samples_ms"], 99)), 3
+            ) if m["steady_samples_ms"] else None
+        ),
+        "spec_accept_rate": (
+            round(m["spec_accept_rate"], 4)
+            if m["spec_accept_rate"] is not None else None
         ),
         "per_replica": {
             rep: {
@@ -393,6 +434,12 @@ def main() -> int:
                         help="0 = greedy")
     parser.add_argument("--top-k", type=int, default=None)
     parser.add_argument("--top-p", type=float, default=None)
+    parser.add_argument("--spec-tokens", type=int, default=0,
+                        help="speculative decoding window K >= 2 (0 = "
+                        "off): the model drafts for itself "
+                        "(self-speculation), the verify step commits the "
+                        "exact-match prefix — output stays bit-identical "
+                        "to non-speculative decode at any temperature")
     parser.add_argument("--mode", default="continuous",
                         choices=("continuous", "static"),
                         help="static = classic wave batching (admit only "
@@ -431,6 +478,8 @@ def main() -> int:
         parser.error("--replicas must be >= 1")
     if args.max_blocks * args.block_size > args.max_len:
         parser.error("--max-blocks * --block-size must be <= --max-len")
+    if args.spec_tokens and args.spec_tokens < 2:
+        parser.error("--spec-tokens must be 0 (off) or >= 2")
     if args.auto_mesh and args.mesh:
         parser.error("--auto-mesh replaces --mesh; drop one")
 
@@ -479,6 +528,12 @@ def main() -> int:
         "unit": "tokens/sec",
         "ttft_ms": m["ttft_ms"],
         "tpot_ms": m["tpot_ms"],
+        "tpot_p99_ms": m["tpot_ms"]["p99"],
+        "decode_tokens_per_sec": round(m["decode_tokens_per_sec"], 2),
+        "spec_accept_rate": (
+            round(m["spec_accept_rate"], 4)
+            if m["spec_accept_rate"] is not None else None
+        ),
         "slot_occupancy": round(m["slot_occupancy"], 4),
         "decode_steps": m["decode_steps"],
         "generated_tokens": m["generated_tokens"],

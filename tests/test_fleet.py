@@ -175,6 +175,42 @@ def test_kill_replica_midstream_replays_token_exact(temperature, top_k):
         assert got["tokens"] == refs[r.rid], r.rid
 
 
+def test_kill_replica_with_speculation_replays_token_exact():
+    """Journal replay stays bit-identical with speculative decoding ON:
+    the accept/reject sequence is a pure function of params + prompt +
+    position-folded rng, so a replica loss mid-window replays to the
+    same committed tokens — checked against a NON-speculative single
+    engine, the strongest form of the determinism claim."""
+    model, params = _model()
+
+    def spec_engine():
+        return InferenceEngine(
+            model, params, num_slots=3, temperature=0.0,
+            draft_model=model, draft_params=params, spec_tokens=3,
+        )
+
+    reqs = _requests(n=8)
+    refs = _single_reference(reqs)  # plain greedy engine, no speculation
+    # warm the propose/verify programs (shared jit cache) so compiles
+    # don't freeze replica heartbeats mid-run
+    spec_engine().run(reqs)
+    handles = [ReplicaHandle(f"r{i}", spec_engine()) for i in range(2)]
+    router = FleetRouter(handles, heartbeat_timeout_s=2.0)
+    _install(chaos.Fault("kill-replica", at="r1", step=2))
+    try:
+        report = router.run(reqs)
+    finally:
+        chaos.uninstall()
+    m = report["metrics"]
+    assert m["replicas_lost"] == 1
+    assert m["replayed"] >= 1
+    assert m["replay_token_exact"] is True
+    for r in reqs:
+        got = report["results"][r.rid]
+        assert got["status"] == "done"
+        assert got["tokens"] == refs[r.rid], r.rid
+
+
 def test_stall_replica_detected_by_heartbeat_deadline():
     reqs = _requests(n=8)
     refs = _single_reference(reqs)

@@ -268,6 +268,65 @@ def test_ckpt_stamp_real_checkpoint_module_lints_clean():
 
 
 @pytest.mark.lint
+def test_decode_gather_fires_on_pool_gather():
+    """A serving/models function that touches the paged pool via
+    take/dynamic_update_slice without routing through the fused dispatch
+    is re-materializing the gathered cache — the cost the kernel exists
+    to remove."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "def decode(pages_k, table):\n"
+        "    return jnp.take(pages_k, table, axis=0)\n"
+    )
+    findings = pylint_rules.lint_source("models/transformer.py", src)
+    assert _rules(findings) == ["decode-gather"]
+    findings = pylint_rules.lint_source("serving/engine.py", src)
+    assert _rules(findings) == ["decode-gather"]
+
+
+@pytest.mark.lint
+def test_decode_gather_quiet_with_fused_dispatch():
+    # routing through the dispatcher sanctions pool access in the same
+    # function (the dispatcher owns the gather fallback internally)
+    src = (
+        "import jax.numpy as jnp\n"
+        "from x import paged_decode_attention\n"
+        "def decode(q, pages_k, pages_v, table, lens):\n"
+        "    pages_k = jax.lax.dynamic_update_slice(pages_k, q, (0,))\n"
+        "    return paged_decode_attention(q, pages_k, pages_v, table, lens)\n"
+    )
+    assert pylint_rules.lint_source("models/transformer.py", src) == []
+
+
+@pytest.mark.lint
+def test_decode_gather_suppression_and_scope():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def decode(pages_k, table):\n"
+        "    return jnp.take(pages_k, table, axis=0)"
+        "  # graft-lint: decode-gather\n"
+    )
+    assert pylint_rules.lint_source("models/transformer.py", src) == []
+    # outside serving//models/ (the reference implementation in ops/, a
+    # test helper) the rule stays quiet
+    src2 = (
+        "import jax.numpy as jnp\n"
+        "def reference(pages_k, table):\n"
+        "    return jnp.take(pages_k, table, axis=0)\n"
+    )
+    assert pylint_rules.lint_source(
+        "ops/pallas/paged_attention.py", src2
+    ) == []
+    # functions that never touch a pages_* identifier are not decode
+    src3 = (
+        "import jax.numpy as jnp\n"
+        "def embed(table, ids):\n"
+        "    return jnp.take(table, ids, axis=0)\n"
+    )
+    assert pylint_rules.lint_source("models/transformer.py", src3) == []
+
+
+@pytest.mark.lint
 def test_serve_dynamic_shape_fires_on_shape_branch_and_append():
     src = (
         "from functools import partial\n"
@@ -631,6 +690,7 @@ def test_parse_markers_greps_named_scopes():
     )
     assert coll.parse_markers(text) == {
         "1f1b_stash_apply": True, "1f1b_recompute_apply": False,
+        "paged_decode_fused": False,
     }
 
 
@@ -729,6 +789,37 @@ def test_compare_budgets_wire_signature():
     )
     assert v == []
 
+
+@pytest.mark.lint
+def test_compare_budgets_paged_decode_signature():
+    """The paged-decode structural contract: serve/decode must carry the
+    fused-dispatch named-scope marker. A silent fall-back to gathering
+    the whole pool moves no collective bytes on a replicated pool — only
+    the signature catches it."""
+    committed = {"all-reduce": {"count": 8, "bytes": 17408}}
+    measured = {"all-reduce": {"count": 8, "bytes": 17408}}
+    ok = {"paged_decode_fused": True}
+    fell_back = {"paged_decode_fused": False}
+
+    v, _ = coll.compare_budgets(
+        committed, measured, signature="paged-decode-fused", markers=ok
+    )
+    assert v == []
+    v, _ = coll.compare_budgets(
+        committed, measured, signature="paged-decode-fused",
+        markers=fell_back,
+    )
+    assert _rules(v) == ["comm-paged-decode-signature"]
+    assert v[0].where == "paged_decode_fused"
+    # no markers at all (hand-edited budget refresh): still loud
+    v, _ = coll.compare_budgets(
+        committed, measured, signature="paged-decode-fused", markers=None
+    )
+    assert _rules(v) == ["comm-paged-decode-signature"]
+    # without the signature the marker's absence is invisible
+    assert coll.compare_budgets(
+        committed, measured, markers=fell_back
+    )[0] == []
 
 @pytest.mark.lint
 def test_parse_collective_dtypes_breakdown():
@@ -943,6 +1034,11 @@ def test_budget_file_covers_all_configs():
     assert set(budgets["configs"]) == names
     meta = budgets["_meta"]
     assert meta["n_devices"] == 8 and "jax" in meta
+    # serve/decode is pinned to the fused paged-decode dispatch: the
+    # committed entry must carry the structural signature + its marker
+    decode = budgets["configs"]["serve/decode"]
+    assert decode["signature"] == "paged-decode-fused"
+    assert decode["markers"]["paged_decode_fused"] is True
 
 
 # ---------------------------------------------------------------------------

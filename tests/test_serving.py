@@ -389,3 +389,120 @@ def test_paged_model_requires_decode_mode():
         GPT2(**GPT2_KW, **PAGED).init(
             jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
         )
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding: bit-identical output, fewer decode boundaries
+# ---------------------------------------------------------------------------
+
+
+def _spec_engine(paged_model, params, spec_tokens=4, **kw):
+    """Self-speculation (draft = target): zero model risk, and the
+    exact-match acceptance rule is exercised identically to a real small
+    draft — only the accept RATE differs."""
+    return InferenceEngine(
+        paged_model, params, draft_model=paged_model, draft_params=params,
+        spec_tokens=spec_tokens, **kw,
+    )
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_spec_greedy_token_exact(family):
+    """Speculative greedy == generate(): acceptance commits only drafts
+    the target would have emitted, so the output is the non-speculative
+    stream bit-for-bit — while taking strictly fewer decode boundaries."""
+    decode_model, paged_model, params = _family(family)
+    prompts = _prompts((8, 5, 11), seed=6)
+    refs = _refs(decode_model, params, prompts, max_new=12, temperature=0.0)
+    plain = InferenceEngine(
+        paged_model, params, num_slots=2, temperature=0.0
+    )
+    plain_steps = plain.run(_requests(prompts, max_new=12))["metrics"][
+        "decode_steps"
+    ]
+    engine = _spec_engine(paged_model, params, num_slots=2, temperature=0.0)
+    report = engine.run(_requests(prompts, max_new=12))
+    for i in range(len(prompts)):
+        r = report["results"][f"r{i}"]
+        assert r["status"] == "done"
+        assert r["tokens"] == refs[i]
+    # the boundary amortization actually happened (greedy self-spec
+    # accepts every draft, so ~K tokens commit per boundary)
+    assert report["metrics"]["decode_steps"] < plain_steps
+
+
+def test_spec_seeded_sampling_token_exact():
+    """Exact-match acceptance is temperature-independent: the verify step
+    samples each window position with the SAME position-folded key the
+    sequential path would use, so sampled speculative output reproduces
+    generate(rng_fold="position") bit-for-bit too."""
+    decode_model, paged_model, params = _family("gpt2")
+    prompts = _prompts((8, 5, 11), seed=7)
+    sample_kw = dict(temperature=0.9, top_k=5)
+    refs = _refs(decode_model, params, prompts, max_new=10, **sample_kw)
+    engine = _spec_engine(paged_model, params, num_slots=2, **sample_kw)
+    report = engine.run(_requests(prompts, max_new=10))
+    for i in range(len(prompts)):
+        assert report["results"][f"r{i}"]["tokens"] == refs[i]
+
+
+def test_spec_preemption_restart_bit_identical():
+    """Block pressure with a speculative window in flight: the preempted
+    request replays to the same tokens — speculative growth is clamped to
+    the request ceiling and the rng folds absolute positions, so the
+    accept/reject sequence replays exactly."""
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+
+    decode_model, _, params = _family("gpt2")
+    model = GPT2(**GPT2_KW, decode=True, paged_num_blocks=12,
+                 paged_block_size=4, paged_max_blocks=8)
+    prompts = _prompts((8, 8), seed=8)
+    refs = _refs(decode_model, params, prompts, temperature=0.0,
+                 max_new=20)
+    engine = _spec_engine(model, params, num_slots=2, temperature=0.0)
+    report = engine.run(_requests(prompts, max_new=20))
+    assert report["metrics"]["preempted"] >= 1
+    for i in range(2):
+        r = report["results"][f"r{i}"]
+        assert r["status"] == "done"
+        assert r["tokens"] == refs[i]
+
+
+def test_spec_metrics_reported():
+    """The report carries the serve-line decode metrics: tokens/sec over
+    decode-boundary wall time and the drafted-token accept rate (1.0 for
+    greedy self-speculation except final-window ceiling truncation)."""
+    _, paged_model, params = _family("gpt2")
+    prompts = _prompts((8, 5), seed=9)
+    engine = _spec_engine(paged_model, params, num_slots=2, temperature=0.0)
+    m = engine.run(_requests(prompts, max_new=12))["metrics"]
+    assert m["decode_tokens"] > 0
+    assert m["decode_tokens_per_sec"] > 0
+    assert m["spec_accept_rate"] is not None
+    assert 0.8 <= m["spec_accept_rate"] <= 1.0
+    plain = InferenceEngine(
+        paged_model, params, num_slots=2, temperature=0.0
+    )
+    pm = plain.run(_requests(prompts, max_new=12))["metrics"]
+    assert pm["spec_accept_rate"] is None  # speculation off -> no rate
+    assert pm["decode_tokens"] > 0
+
+
+def test_spec_requires_matching_geometry():
+    """A draft with a different paged geometry cannot share the engine's
+    table layout; the constructor refuses it up front."""
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+
+    _, paged_model, params = _family("gpt2")
+    other = GPT2(**GPT2_KW, decode=True, paged_num_blocks=16,
+                 paged_block_size=8, paged_max_blocks=4)
+    with pytest.raises(ValueError, match="geometry|paged"):
+        InferenceEngine(
+            paged_model, params, draft_model=other, draft_params=params,
+            spec_tokens=4,
+        )
+    with pytest.raises(ValueError, match="spec_tokens"):
+        InferenceEngine(
+            paged_model, params, draft_model=paged_model,
+            draft_params=params, spec_tokens=1,
+        )
