@@ -596,6 +596,30 @@ def run_model(name: str, args) -> dict:
             print(f"bench: input-plane probe failed: {e}", file=sys.stderr)
             intake_report = None
 
+        # graft-lens overlap accounting (post-timing probe, ROADMAP 5(c)):
+        # a short XLA trace of the SAME compiled step, split into
+        # collective vs compute self time — overlap_frac is the fraction
+        # of collective time hidden behind compute. None when the profile
+        # plugin or trace conversion is unavailable (e.g. plain CPU runs).
+        overlap_report = None
+        try:
+            import tempfile
+
+            from distributed_pytorch_example_tpu.telemetry import (
+                measure_overlap,
+            )
+
+            def _overlap_steps(n, _s=[state]):
+                for _ in range(n):
+                    _s[0], m = step(_s[0], batch)
+                float(m["loss"])  # value fetch fences the dispatch chain
+
+            with tempfile.TemporaryDirectory() as td:
+                overlap_report = measure_overlap(_overlap_steps, td)
+        except Exception as e:  # noqa: BLE001 - probe must not kill the run
+            print(f"bench: overlap probe failed: {e}", file=sys.stderr)
+            overlap_report = None
+
     samples_per_sec = global_batch * args.steps / elapsed
     unit_kind, baseline = BASELINES[name]
     if unit_kind == "tokens":
@@ -664,6 +688,17 @@ def run_model(name: str, args) -> dict:
             **({"auto_mesh": picked_plan} if picked_plan else {}),
         },
     }
+    # measured comm/compute overlap (None = probe unavailable); the
+    # per-step split rides along when the probe ran
+    result["overlap_frac"] = (
+        overlap_report["overlap_frac"] if overlap_report else None
+    )
+    if overlap_report is not None:
+        result["overlap"] = {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in overlap_report.items()
+            if k != "overlap_frac"
+        }
     if chaos_report is not None:
         result["chaos"] = chaos_report
     if intake_report is not None:

@@ -74,6 +74,15 @@ Twelve rules, each a distilled past-regression class:
   are all fine), and ``block=False`` non-blocking gets are fine;
   everything else must pass ``timeout=``.
 
+- ``serve-bare-clock``: a bare ``time.time()`` / ``time.perf_counter()``
+  / ``time.monotonic()`` (or ``from time import ...`` equivalent) CALL
+  inside ``serving/``. graft-lens' contract is that every timed phase
+  boundary in the serving path reads the INJECTED clock (the
+  ``clock=time.monotonic`` constructor default every serving class
+  takes — referencing the function is fine, calling it directly is not)
+  or runs under a trace ``span(...)``: a bare wall-clock call is
+  invisible to the request trace, and a fake-clock test cannot steer it.
+
 - ``wire-raw-collective``: a raw ``psum(...)`` / ``psum_scatter(...)``
   call inside ``train/step.py``. graft-wire's contract is that EVERY
   gradient collective in the step routes through ``parallel/wire.py``
@@ -514,6 +523,62 @@ def _fleet_unbounded_wait_findings(
     return [flagged[k] for k in sorted(flagged)]
 
 
+_CLOCK_NAMES = (
+    "time", "perf_counter", "perf_counter_ns", "monotonic", "monotonic_ns",
+)
+
+
+def _serve_bare_clock_findings(
+    tree: ast.Module, relpath: str, supp: Dict[int, Set[str]]
+) -> List[Finding]:
+    """Bare ``time.time()`` / ``time.perf_counter()`` CALLS in the
+    serving path (module docstring). Referencing a clock (e.g. the
+    ``clock=time.monotonic`` default arg every serving class takes) is
+    fine — it is calling one directly that bypasses the injected clock
+    and the ``span(...)`` phase accounting."""
+    time_aliases = {"time"}
+    from_imports: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in _CLOCK_NAMES:
+                    from_imports.add(a.asname or a.name)
+    flagged: Dict[int, Finding] = {}  # keyed by line: nesting dedup
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if not (
+                fn.attr in _CLOCK_NAMES
+                and _attr_root(fn) in time_aliases
+            ):
+                continue
+            shown = f"time.{fn.attr}"
+        elif isinstance(fn, ast.Name) and fn.id in from_imports:
+            shown = fn.id
+        else:
+            continue
+        if _suppressed(supp, node.lineno, "serve-bare-clock"):
+            continue
+        flagged.setdefault(node.lineno, Finding(
+            rule="serve-bare-clock",
+            where=f"{relpath}:{node.lineno}",
+            message=(
+                f"bare {shown}() call in serving/: phase boundaries must "
+                "read the injected clock (the clock= ctor arg, "
+                "engine._ts_us) or run under trace span(...) so fake "
+                "clocks stay honest in tests and every timed phase lands "
+                "in the graft-lens request trace"
+            ),
+        ))
+    return [flagged[k] for k in sorted(flagged)]
+
+
 _DECODE_GATHER_CALLS = ("take", "dynamic_update_slice")
 _PAGED_DISPATCH = ("paged_decode_attention", "paged_flash_decode")
 
@@ -769,6 +834,7 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
         findings.extend(_ckpt_stamp_findings(tree, relpath, supp))
     if _in_scope(relpath, SERVE_SCOPE):
         findings.extend(_serve_dynamic_shape_findings(tree, relpath, supp))
+        findings.extend(_serve_bare_clock_findings(tree, relpath, supp))
     if _in_scope(relpath, WAIT_SCOPE):
         findings.extend(_fleet_unbounded_wait_findings(tree, relpath, supp))
     if _in_scope(relpath, PLAN_OVERLAY_SCOPE):

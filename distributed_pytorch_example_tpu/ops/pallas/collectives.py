@@ -154,7 +154,15 @@ def ring_all_gather(x, axis_name: str):
     ``lax.all_gather(x, axis_name, axis=0, tiled=True)``. Call inside a
     shard_map manual over ``axis_name``; any backend or payload shape
     the kernel does not cover takes the identical-numerics XLA path.
+    The dispatch boundary carries a ``ring_all_gather`` named scope so
+    graft-lens' overlap accounting (telemetry/overlap.py) can attribute
+    the moved bytes to this kernel in the XLA trace.
     """
+    with jax.named_scope("ring_all_gather"):
+        return _ring_all_gather(x, axis_name)
+
+
+def _ring_all_gather(x, axis_name: str):
     d = _axis_size(axis_name)
     rows = _half_rows(x.size)
     if d == 1 or rows is None or not ring_supported():
@@ -257,8 +265,14 @@ def ring_reduce_scatter(x, axis_name: str, *, scatter_dimension: int = 0):
     drop-in contract of ``lax.psum_scatter(..., tiled=True)``, f32
     accumulation. Falls back to the XLA collective off-TPU and for any
     payload the kernel does not cover (chunk not splittable into two
-    lane-aligned halves).
+    lane-aligned halves). Dispatch carries a ``ring_reduce_scatter``
+    named scope for graft-lens overlap attribution.
     """
+    with jax.named_scope("ring_reduce_scatter"):
+        return _ring_reduce_scatter(x, axis_name, scatter_dimension)
+
+
+def _ring_reduce_scatter(x, axis_name: str, scatter_dimension: int = 0):
     d = _axis_size(axis_name)
     if (
         d == 1

@@ -57,6 +57,7 @@ signature gates at >= 3x (analysis/collectives.py).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Optional
 
 import jax
@@ -69,6 +70,23 @@ RING_MODES = ("auto", "off")
 
 # int8 symmetric range: +-127 (128 is reserved so negation stays exact)
 _QMAX = 127.0
+
+
+def _scoped(name: str):
+    """Stamp a dispatch boundary with a ``jax.named_scope`` so every HLO
+    op the collective lowers to carries the wire-layer scope in its
+    metadata — the attribution key graft-lens' overlap accounting
+    (telemetry/overlap.py) and the comm-budget marker parser grep for."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with jax.named_scope(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 # leaves below this many ELEMENTS stay on the fp32 collective — scale
 # overhead + quantize latency beat the byte savings for biases/scalars
@@ -203,6 +221,7 @@ def _split_key(key, n: int):
     return tuple(jax.random.split(key, n))
 
 
+@_scoped("wire_psum_scatter")
 def wire_psum_scatter(x, axis_name: str, *, scatter_dimension: int,
                       config: Optional[WireConfig] = None, key=None):
     """Drop-in ``lax.psum_scatter(..., tiled=True)`` with optional int8
@@ -247,6 +266,7 @@ def wire_psum_scatter(x, axis_name: str, *, scatter_dimension: int,
     return jnp.sum(got, axis=0).reshape(chunk_shape)
 
 
+@_scoped("wire_all_gather")
 def wire_all_gather(x, axis_name: str, *, gather_dimension: int = 0,
                     config: Optional[WireConfig] = None, key=None):
     """Drop-in tiled ``lax.all_gather`` with optional int8 payloads.
@@ -272,6 +292,7 @@ def wire_all_gather(x, axis_name: str, *, gather_dimension: int = 0,
     )
 
 
+@_scoped("wire_psum")
 def wire_psum(x, axis_name: str, *,
               config: Optional[WireConfig] = None, key=None):
     """Drop-in ``lax.psum`` with optional int8 payloads.
@@ -324,6 +345,7 @@ def _gather(x, axis_name: str, gather_dimension: int,
 # -- ZeRO-1 param re-replication ------------------------------------------
 
 
+@_scoped("wire_replicate_params")
 def replicate_params(params: Any, partitioner, config: WireConfig,
                      axis_name: str = "data"):
     """Explicit wire-configured ZeRO-1 param re-replication all-gather.

@@ -852,6 +852,11 @@ class InferenceEngine:
         self.trace.add_complete(
             f"decode:{rid}", us(st.t_first), us(st.t_done) - us(st.t_first)
         )
+        # graft-lens: host-side finalize window (finish bookkeeping +
+        # detokenize-equivalent result assembly after the last token)
+        self.trace.add_complete(
+            f"finalize:{rid}", us(st.t_done), self._ts_us() - us(st.t_done)
+        )
 
     # -- the serving loop -------------------------------------------------
 
@@ -1082,7 +1087,7 @@ class InferenceEngine:
 
     def _report(self, states, sched, elapsed, decode_steps, occupied_rows):
         results = {}
-        ttft, tpot = [], []
+        ttft, tpot, qwait = [], [], []
         generated = 0
         for rid, st in sorted(states.items()):
             results[rid] = {
@@ -1099,6 +1104,8 @@ class InferenceEngine:
                 generated += len(st.generated)
                 if st.t_first:
                     ttft.append((st.t_first - st.t_submit) * 1e3)
+                if st.t_admit:
+                    qwait.append((st.t_admit - st.t_submit) * 1e3)
                 tpot.extend(
                     (b - a) * 1e3 for a, b in zip(
                         st.token_times, st.token_times[1:]
@@ -1116,6 +1123,7 @@ class InferenceEngine:
             ),
             "ttft_ms": _percentiles(ttft),
             "tpot_ms": _percentiles(tpot),
+            "queue_wait_ms": _percentiles(qwait),
             **self.decode_metrics(),
         }
         return {"results": results, "metrics": metrics}

@@ -285,7 +285,15 @@ class ReplicaHandle:
                 self._prev_decode_t = now
             else:
                 self._prev_decode_t = None
+            free_blocks = self._free_blocks
         if rows:
+            trace = self.engine.trace
+            if trace is not None and hasattr(trace, "counter"):
+                # graft-lens: per-boundary KV-pool / occupancy counter
+                # track, on the replica's own trace pid lane
+                trace.counter(
+                    "kv", {"free_blocks": free_blocks, "rows": rows}
+                )
             action = chaos.replica_fault(self.replica_id, step_idx)
             if action == "kill":
                 raise ReplicaKilled("chaos kill-replica")

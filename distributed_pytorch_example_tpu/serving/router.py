@@ -44,6 +44,7 @@ from distributed_pytorch_example_tpu.robustness import chaos
 from distributed_pytorch_example_tpu.robustness.retry import with_retries
 from distributed_pytorch_example_tpu.serving.fleet import ReplicaHandle
 from distributed_pytorch_example_tpu.serving.scheduler import Request
+from distributed_pytorch_example_tpu.telemetry.lens import LatencyBook
 
 __all__ = ["FleetRouter", "JournalEntry"]
 
@@ -86,6 +87,8 @@ class FleetRouter:
         dispatch_attempts: int = 4,
         dispatch_base_delay: float = 0.01,
         trace=None,
+        sentinels=None,
+        sentinel_interval_s: float = 0.01,
     ):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
@@ -101,6 +104,12 @@ class FleetRouter:
         self.dispatch_attempts = int(dispatch_attempts)
         self.dispatch_base_delay = float(dispatch_base_delay)
         self.trace = trace
+        # graft-lens: optional ServeSentinels polled at most once per
+        # `sentinel_interval_s` of wall time (the armed check is a
+        # handful of comparisons; the throttle keeps the loop unburdened
+        # however slowly GIL contention makes its ticks turn over)
+        self.sentinels = sentinels
+        self.sentinel_interval_s = float(sentinel_interval_s)
 
         self._completions: "queue.Queue[dict]" = queue.Queue()
         self._affinity: Dict[str, str] = {}  # session -> replica_id
@@ -111,6 +120,13 @@ class FleetRouter:
             "dispatch_retries": 0, "stale_results": 0,
         }
         self._queue_depth_max = 0
+        # graft-lens rolling latency windows (ms, except kv_occupancy =
+        # used fraction); bounded memory regardless of workload size
+        self.latency = LatencyBook()
+        self._tpot_fed: Dict[str, int] = {}
+        self._last_queue_depth = -1
+        self._ticks = 0
+        self._next_observe = 0.0
 
     # -- placement ---------------------------------------------------------
 
@@ -178,6 +194,8 @@ class FleetRouter:
         entry.replica = handle.replica_id
         entry.dispatches += 1
         entry.t_dispatch = now
+        if entry.dispatches == 1:
+            self.latency.add("queue_wait_ms", (now - entry.t_submit) * 1e3)
         if req.session is not None:
             self._affinity[req.session] = handle.replica_id
         if self.trace is not None:
@@ -239,6 +257,13 @@ class FleetRouter:
             # front-requeue in original FIFO order: the lost replica's
             # requests keep their seniority, like preempt_youngest
             rqueue.extendleft(reversed(moved))
+            if self.sentinels is not None:
+                # a lost replica is the terminal straggler: a dead worker
+                # thread never ages past the heartbeat deadline, so the
+                # loss event feeds the straggler detector directly
+                self.sentinels.notice_lost_replica(
+                    rep, now - beat, step=self._ticks
+                )
             if self.trace is not None:
                 self.trace.add_complete(
                     f"router/replica_lost:{rep}", int(beat * 1e6),
@@ -276,10 +301,53 @@ class FleetRouter:
             entry.error = res.get("error", "")
             entry.result = res
             entry.t_done = res.get("t_done", self.clock())
+            # graft-lens latency samples: TTFT as seen by the replica,
+            # journal lag = completion sitting in the queue before the
+            # router's single thread observed it
+            ttft = res.get("ttft_s")
+            if ttft is not None:
+                self.latency.add("ttft_ms", float(ttft) * 1e3)
+            if "t_done" in res:
+                self.latency.add(
+                    "journal_lag_ms",
+                    max(self.clock() - res["t_done"], 0.0) * 1e3,
+                )
             if entry.replays and entry.status == "done":
                 entry.replay_token_exact = (
                     res["tokens"][: len(entry.tokens)] == entry.tokens
                 )
+
+    # -- graft-lens instrumentation ----------------------------------------
+
+    def _observe_fleet(self, now: float) -> None:
+        """Feed the serve-side sentinels (and the occupancy samples) from
+        the replicas' boundary snapshots. Runs at most once per
+        ``sentinel_interval_s`` so the routing loop stays cheap."""
+        ages: Dict[str, float] = {}
+        worst_used = 0.0
+        for handle in self.replicas:
+            rep = handle.replica_id
+            if rep in self._lost or handle.state() == "stopped":
+                continue
+            ages[rep] = max(now - handle.last_beat(), 0.0)
+            pool = max(handle.engine.config.num_blocks - 1, 1)
+            snap = handle.snapshot()
+            used = 1.0 - snap["free_blocks"] / pool
+            worst_used = max(worst_used, used)
+            samples = handle.step_samples()
+            fed = self._tpot_fed.get(rep, 0)
+            for (_t, per_row) in samples[fed:]:
+                self.latency.add("tpot_ms", per_row * 1e3)
+                if self.sentinels is not None:
+                    self.sentinels.observe_tpot(per_row * 1e3)
+            self._tpot_fed[rep] = len(samples)
+        self.latency.add("kv_occupancy", worst_used)
+        if self.sentinels is not None:
+            self.sentinels.check(
+                self._ticks,
+                heartbeat_ages=ages or None,
+                kv_used_frac=worst_used,
+            )
 
     # -- the routing loop --------------------------------------------------
 
@@ -326,6 +394,17 @@ class FleetRouter:
                 self._queue_depth_max = max(
                     self._queue_depth_max, len(rqueue)
                 )
+                if (
+                    self.trace is not None
+                    and len(rqueue) != self._last_queue_depth
+                ):
+                    # counter track, emitted only on change
+                    self.trace.counter("router/queue_depth", len(rqueue))
+                    self._last_queue_depth = len(rqueue)
+                self._ticks += 1
+                if now >= self._next_observe:
+                    self._observe_fleet(now)
+                    self._next_observe = now + self.sentinel_interval_s
 
                 # completions BEFORE health: a finished request must never
                 # be replayed because its replica died a tick later
@@ -450,6 +529,23 @@ class FleetRouter:
             # chaos run's pre-loss window so both sides are equally
             # contended); stripped from emitted JSON lines
             "steady_samples_ms": [s * 1e3 for s in samples],
+            # graft-lens rolling latency summaries (ms); None until the
+            # first sample of each kind lands
+            "ttft_p99_ms": self.latency.p99("ttft_ms"),
+            "ttft_p50_ms": self.latency.stats["ttft_ms"].percentile(50),
+            "queue_wait_p99_ms": self.latency.p99("queue_wait_ms"),
+            "queue_wait_p50_ms": (
+                self.latency.stats["queue_wait_ms"].percentile(50)
+            ),
+            "journal_lag_p99_ms": self.latency.p99("journal_lag_ms"),
+            "kv_occupancy_max": (
+                self.latency.stats["kv_occupancy"].snapshot()["max"]
+            ),
+            "latency": self.latency.snapshot(),
+            "sentinel_triggers": (
+                list(self.sentinels.triggers)
+                if self.sentinels is not None else []
+            ),
             "per_replica": per_replica,
         }
         return {"results": results, "metrics": metrics}

@@ -21,9 +21,29 @@ graft-scope rebuilds that surface TPU-first around four pillars:
   streamed as Chrome trace-event JSON (load in Perfetto / chrome://tracing)
   next to ``metrics.jsonl``.
 
+graft-lens extends the same substrate end-to-end across serving and the
+wire collectives:
+
+- **request tracing + rolling latency histograms** (:mod:`~.trace`
+  counters/instants + :mod:`~.lens`): router→replica→engine request
+  spans on per-replica Perfetto pids, queue-depth/KV-occupancy counter
+  tracks, and bounded p50/p99 windows for TTFT/TPOT/queue-wait/journal
+  lag surfaced in ``serve.py``'s JSON line;
+- **overlap accounting** (:mod:`~.overlap`): a short XLA trace split
+  into collective vs compute self time → measured ``overlap_frac`` in
+  ``bench.py``'s JSON line (ROADMAP 5(c));
+- **serve-side self-arming sentinels** (:mod:`~.sentinels`
+  ``ServeSentinels``): TPOT p99 regression, straggler replica, KV-pool
+  pressure — auto-arm the XLA profiler and stamp ``trigger`` events.
+
 :class:`~.scope.Telemetry` is the facade the Trainer drives; everything here
 degrades to a no-op when unconfigured.
 """
+
+from distributed_pytorch_example_tpu.telemetry.lens import (  # noqa: F401
+    LatencyBook,
+    RollingStats,
+)
 
 from distributed_pytorch_example_tpu.telemetry.cost import (  # noqa: F401
     CostRegistry,
@@ -34,8 +54,15 @@ from distributed_pytorch_example_tpu.telemetry.scope import (  # noqa: F401
     Telemetry,
     TelemetryConfig,
 )
+from distributed_pytorch_example_tpu.telemetry.overlap import (  # noqa: F401
+    measure_overlap,
+    overlap_frac_from_times,
+    split_trace_times,
+)
 from distributed_pytorch_example_tpu.telemetry.sentinels import (  # noqa: F401
     SENTINEL_KEYS,
+    SERVE_TRIGGER_KINDS,
+    ServeSentinels,
     sentinel_metrics,
 )
 from distributed_pytorch_example_tpu.telemetry.steptime import (  # noqa: F401
@@ -43,5 +70,6 @@ from distributed_pytorch_example_tpu.telemetry.steptime import (  # noqa: F401
     exchange_step_times,
 )
 from distributed_pytorch_example_tpu.telemetry.trace import (  # noqa: F401
+    PrefixedTrace,
     TraceWriter,
 )

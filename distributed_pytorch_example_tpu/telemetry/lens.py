@@ -1,0 +1,98 @@
+"""graft-lens rolling request-latency histograms.
+
+The serving path accumulates latency samples (TTFT, TPOT, queue wait,
+journal lag) and occupancy fractions into bounded :class:`RollingStats`
+windows — O(1) memory per metric regardless of request count — and
+surfaces p50/p99 summaries in ``serve.py``'s single JSON line plus an
+optional ``--metrics-snapshot`` dump for offline inspection next to the
+Perfetto trace.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+
+class RollingStats:
+    """A bounded sample window with percentile summaries."""
+
+    def __init__(self, window: int = 2048):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self._samples = deque(maxlen=int(window))
+        self.total_count = 0
+
+    def add(self, value: float) -> None:
+        self._samples.append(float(value))
+        self.total_count += 1
+
+    def extend(self, values: Iterable[float]) -> None:
+        for v in values:
+            self.add(v)
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def percentile(self, q: float) -> Optional[float]:
+        if not self._samples:
+            return None
+        return float(np.percentile(list(self._samples), q))
+
+    def snapshot(self) -> dict:
+        """{count, p50, p99, max} over the rolling window (count is the
+        all-time sample count; percentiles cover the window)."""
+        if not self._samples:
+            return {"count": self.total_count, "p50": None, "p99": None,
+                    "max": None}
+        arr = np.asarray(self._samples)
+        return {
+            "count": self.total_count,
+            "p50": float(np.percentile(arr, 50)),
+            "p99": float(np.percentile(arr, 99)),
+            "max": float(arr.max()),
+        }
+
+
+class LatencyBook:
+    """The named rolling metrics one serve run keeps (graft-lens)."""
+
+    METRICS = (
+        "ttft_ms", "tpot_ms", "queue_wait_ms", "journal_lag_ms",
+        "kv_occupancy",
+    )
+
+    def __init__(self, window: int = 2048):
+        self.stats: Dict[str, RollingStats] = {
+            name: RollingStats(window) for name in self.METRICS
+        }
+
+    def add(self, name: str, value: float) -> None:
+        self.stats[name].add(value)
+
+    def extend(self, name: str, values: Iterable[float]) -> None:
+        self.stats[name].extend(values)
+
+    def p99(self, name: str) -> Optional[float]:
+        return self.stats[name].percentile(99)
+
+    def snapshot(self) -> dict:
+        return {name: s.snapshot() for name, s in self.stats.items()}
+
+    def write_snapshot(self, path: str, extra: Optional[dict] = None) -> dict:
+        """Dump the full histogram summary as one JSON file (the
+        ``serve.py --metrics-snapshot`` artifact) and return it."""
+        payload = {"metrics": self.snapshot()}
+        if extra:
+            payload.update(extra)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        return payload

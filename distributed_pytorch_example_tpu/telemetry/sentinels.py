@@ -1,4 +1,5 @@
-"""Device-side health sentinels, computed INSIDE the jitted train step.
+"""Health sentinels: device-side train-step scalars + host-side serve
+anomaly detectors (graft-lens).
 
 The reference reads training health off a per-step host sync
 (``loss.item()``, reference train.py:141). Here the health scalars — global
@@ -11,14 +12,27 @@ graft-lint rule forbids those in the step).
 Under sharded configs (FSDP / ZeRO-1 / pipeline) the leaves these norms
 reduce over are sharded arrays; the partial-sum all-reduce GSPMD inserts is
 part of the committed comm budget (``analysis/comm_budgets.json``).
+
+:class:`ServeSentinels` extends the trigger plane to the serving path:
+TPOT p99 regression vs a rolling baseline, straggler replica (heartbeat
+age outlier), and KV-pool pressure — host-side detectors the fleet router
+polls once per health tick. On a trigger they auto-arm the XLA profiler
+(``runtime/profiler.py StepProfiler.arm``) and stamp a ``trigger:<kind>``
+instant event into the trace, with the same degrade-to-no-op contract as
+graft-scope: no profiler means detect-and-stamp only, no trace means
+detect-and-arm only, neither means pure rolling statistics.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # the keys sentinel_metrics adds to the step's metrics dict
 SENTINEL_KEYS = ("grad_norm", "param_norm", "nonfinite_grads")
@@ -58,3 +72,180 @@ def sentinel_metrics(grads: Any, params: Any) -> Dict[str, jax.Array]:
         "param_norm": global_norm(params),
         "nonfinite_grads": nonfinite_count(grads),
     }
+
+
+# ---------------------------------------------------------------------------
+# serve-side self-arming sentinels (graft-lens)
+# ---------------------------------------------------------------------------
+
+SERVE_TRIGGER_KINDS = ("tpot-regression", "straggler-replica", "kv-pressure")
+
+
+class ServeSentinels:
+    """Host-side anomaly detectors for the serving fleet.
+
+    The router feeds these once per health tick (single-threaded loop;
+    ``observe_tpot`` additionally tolerates replica worker threads via an
+    internal lock). Each detector fires AT MOST ONCE until :meth:`disarm`
+    — the graft-scope first-trigger-wins contract: ``StepProfiler.arm``
+    refuses overlapping windows anyway, and one stamp per incident keeps
+    the trace readable. Every component degrades to a no-op: detectors
+    without a profiler only stamp, without a trace only arm, with neither
+    they just accumulate rolling statistics.
+
+    - ``tpot-regression``: p99 of the most recent ``recent_window`` TPOT
+      samples exceeds ``regression_factor`` x the median of the rolling
+      baseline window that preceded them;
+    - ``straggler-replica``: a replica's heartbeat age exceeds
+      ``straggler_age_s`` AND is a >=3x outlier vs the median live age
+      (single-replica fleets use the absolute bound alone);
+    - ``kv-pressure``: the fleet-max used fraction of the paged KV pool
+      reaches ``pressure_frac``.
+    """
+
+    def __init__(
+        self,
+        *,
+        profiler: Optional[Any] = None,
+        trace: Optional[Any] = None,
+        clock=time.monotonic,
+        baseline_window: int = 64,
+        recent_window: int = 16,
+        regression_factor: float = 2.0,
+        straggler_age_s: float = 1.0,
+        pressure_frac: float = 0.95,
+        arm_offset: int = 1,
+        arm_span: int = 2,
+    ):
+        if recent_window < 2 or baseline_window < recent_window:
+            raise ValueError(
+                "need baseline_window >= recent_window >= 2, got "
+                f"{baseline_window}/{recent_window}"
+            )
+        self.profiler = profiler
+        self.trace = trace
+        self.clock = clock
+        self.recent_window = int(recent_window)
+        self.regression_factor = float(regression_factor)
+        self.straggler_age_s = float(straggler_age_s)
+        self.pressure_frac = float(pressure_frac)
+        self.arm_offset = int(arm_offset)
+        self.arm_span = int(arm_span)
+        self._tpot = deque(maxlen=int(baseline_window + recent_window))
+        self._tpot_lock = threading.Lock()
+        self._fired: Dict[str, dict] = {}
+        self.triggers: List[dict] = []
+
+    # -- sample intake ----------------------------------------------------
+
+    def observe_tpot(self, per_row_ms: float) -> None:
+        """One steady-state decode-boundary per-row time (TPOT sample)."""
+        with self._tpot_lock:
+            self._tpot.append(float(per_row_ms))
+
+    # -- detectors --------------------------------------------------------
+
+    def _tpot_regression(self) -> Optional[dict]:
+        with self._tpot_lock:
+            samples = list(self._tpot)
+        if len(samples) < 2 * self.recent_window:
+            return None  # not enough history for baseline + recent
+        recent = samples[-self.recent_window:]
+        baseline = samples[:-self.recent_window]
+        base_med = float(np.median(baseline))
+        recent_p99 = float(np.percentile(recent, 99))
+        if base_med > 0 and recent_p99 > self.regression_factor * base_med:
+            return {
+                "tpot_p99_ms": recent_p99,
+                "baseline_median_ms": base_med,
+                "ratio": recent_p99 / base_med,
+            }
+        return None
+
+    def _straggler(self, heartbeat_ages: Dict[str, float]) -> Optional[dict]:
+        if not heartbeat_ages:
+            return None
+        ages = sorted(heartbeat_ages.values())
+        worst_rep = max(heartbeat_ages, key=heartbeat_ages.get)
+        worst = heartbeat_ages[worst_rep]
+        if worst < self.straggler_age_s:
+            return None
+        med = float(np.median(ages))
+        if len(ages) > 1 and worst < 3.0 * max(med, 1e-9):
+            return None  # everyone is slow (compile, loaded box): no outlier
+        return {"replica": worst_rep, "age_s": worst, "median_age_s": med}
+
+    def _kv_pressure(self, kv_used_frac: float) -> Optional[dict]:
+        if kv_used_frac < self.pressure_frac:
+            return None
+        return {"kv_used_frac": kv_used_frac}
+
+    # -- the poll ---------------------------------------------------------
+
+    def check(
+        self,
+        step: int,
+        *,
+        heartbeat_ages: Optional[Dict[str, float]] = None,
+        kv_used_frac: Optional[float] = None,
+    ) -> List[dict]:
+        """Evaluate every detector; fire, stamp, and arm for new ones.
+
+        ``step`` is the caller's decode-boundary/step counter — the unit
+        the armed profiler window is expressed in. Returns the newly
+        fired triggers (empty almost always: the armed check is a few
+        comparisons, safe at every health tick).
+        """
+        fired = []
+        detections = {
+            "tpot-regression": self._tpot_regression(),
+            "straggler-replica": self._straggler(heartbeat_ages or {}),
+            "kv-pressure": (
+                self._kv_pressure(kv_used_frac)
+                if kv_used_frac is not None else None
+            ),
+        }
+        for kind, detail in detections.items():
+            if detail is None or kind in self._fired:
+                continue
+            fired.append(self._fire(kind, step, detail))
+        return fired
+
+    def notice_lost_replica(
+        self, replica: str, age_s: float, *, step: int = 0
+    ) -> Optional[dict]:
+        """A replica the router declared lost is the terminal straggler —
+        its worker thread dies (or is reclaimed) before any heartbeat age
+        can trip the rolling detector, so the router reports the loss
+        here directly. Fires through the same once-until-disarm path as
+        :meth:`check`'s ``straggler-replica`` detector."""
+        if "straggler-replica" in self._fired:
+            return None
+        return self._fire(
+            "straggler-replica", step,
+            {"replica": replica, "age_s": float(age_s), "lost": True},
+        )
+
+    def _fire(self, kind: str, step: int, detail: dict) -> dict:
+        trigger = {"kind": kind, "step": int(step), **detail}
+        self._fired[kind] = trigger
+        self.triggers.append(trigger)
+        if self.trace is not None:
+            self.trace.instant(f"trigger:{kind}", **detail)
+        if self.profiler is not None and hasattr(self.profiler, "arm"):
+            start = int(step) + self.arm_offset
+            self.profiler.arm(
+                start, start + self.arm_span, reason=f"serve {kind}"
+            )
+        return trigger
+
+    def disarm(self, kind: Optional[str] = None) -> None:
+        """Re-enable a detector (or all) after its incident is handled;
+        past triggers stay on :attr:`triggers` for the summary."""
+        if kind is None:
+            self._fired.clear()
+        else:
+            self._fired.pop(kind, None)
+
+    def summary(self) -> dict:
+        return {"triggers": list(self.triggers)}

@@ -186,7 +186,12 @@ def build_engines(args, trace, built, n):
             model, params, num_slots=args.slots,
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, partitioner=partitioner,
-            trace=PrefixedTrace(trace, f"r{i}") if n > 1 else trace,
+            # graft-lens: each replica gets its own Perfetto process lane
+            # (pid 0 is the router/host) inside the ONE shared trace file
+            trace=(
+                PrefixedTrace(trace, f"r{i}", pid=i + 1)
+                if n > 1 else trace
+            ),
             mode=args.mode, **spec,
         ))
     return engines
@@ -218,18 +223,24 @@ def run_fleet(args, trace, built, requests):
     from distributed_pytorch_example_tpu.serving import (
         FleetRouter, ReplicaHandle,
     )
+    from distributed_pytorch_example_tpu.telemetry import ServeSentinels
 
     def one_pass(tag):
         engines = build_engines(args, trace, built, args.replicas)
         handles = [
             ReplicaHandle(f"r{i}", eng) for i, eng in enumerate(engines)
         ]
+        sentinels = ServeSentinels(
+            trace=trace,
+            straggler_age_s=max(args.heartbeat_timeout / 2.0, 0.25),
+        )
         router = FleetRouter(
             handles,
             heartbeat_timeout_s=args.heartbeat_timeout,
             max_queue=args.queue_cap,
             queue_deadline_s=args.queue_deadline,
             trace=trace,
+            sentinels=sentinels,
         )
         print(f"serve: fleet pass '{tag}' ({args.replicas} replicas)",
               file=sys.stderr)
@@ -312,6 +323,34 @@ def _config_dict(args):
     }
 
 
+def _round(value, digits):
+    return round(value, digits) if value is not None else None
+
+
+def write_metrics_snapshot(path, metrics, config):
+    """``--metrics-snapshot``: dump the full rolling-histogram summary
+    (every metric's p50/p99/max, not just the JSON line's headline p99s)
+    next to the trace, for offline inspection."""
+    import os
+
+    payload = {
+        "metrics": {
+            k: v for k, v in metrics.items()
+            if k in (
+                "latency", "ttft_ms", "tpot_ms", "queue_wait_ms",
+                "sentinel_triggers",
+            )
+        },
+        "config": config,
+    }
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
 def emit_fleet_line(args, report, baseline) -> int:
     """The fleet-mode stdout line: same ONE-JSON-line contract, headline
     metric unchanged, plus the router/failover counters the acceptance
@@ -350,6 +389,12 @@ def emit_fleet_line(args, report, baseline) -> int:
         ),
         "replay_token_exact": m["replay_token_exact"],
         "queue_depth_max": m["queue_depth_max"],
+        # graft-lens rolling latency summaries (ms over the run's window)
+        "ttft_p99_ms": _round(m["ttft_p99_ms"], 3),
+        "queue_wait_p99_ms": _round(m["queue_wait_p99_ms"], 3),
+        "journal_lag_p99_ms": _round(m["journal_lag_p99_ms"], 3),
+        "kv_occupancy_max": _round(m["kv_occupancy_max"], 4),
+        "sentinel_triggers": [t["kind"] for t in m["sentinel_triggers"]],
         "generated_tokens": m["generated_tokens"],
         "elapsed_s": round(m["elapsed_s"], 3),
         "steady_per_row_ms": (
@@ -455,6 +500,10 @@ def main() -> int:
                         "plans pre-compile")
     parser.add_argument("--trace", default=None, metavar="PATH",
                         help="write per-request Chrome trace spans here")
+    parser.add_argument("--metrics-snapshot", default=None, metavar="PATH",
+                        help="graft-lens: dump the full rolling-histogram "
+                        "summary (p50/p99/max per latency metric, sentinel "
+                        "triggers) as JSON here")
     parser.add_argument("--replicas", type=int, default=1,
                         help="graft-fleet: serve through N engine replicas "
                         "behind the failover router")
@@ -508,11 +557,20 @@ def main() -> int:
     if args.replicas > 1:
         report, baseline = run_fleet(args, trace, built, requests)
         trace.close()
+        if args.metrics_snapshot:
+            write_metrics_snapshot(
+                args.metrics_snapshot, report["metrics"],
+                _config_dict(args),
+            )
         return emit_fleet_line(args, report, baseline)
 
     engine = build_engines(args, trace, built, 1)[0]
     report = engine.run(requests)
     trace.close()
+    if args.metrics_snapshot:
+        write_metrics_snapshot(
+            args.metrics_snapshot, report["metrics"], _config_dict(args)
+        )
     for rid, r in sorted(report["results"].items()):
         print(json.dumps({
             "rid": rid, "status": r["status"],
@@ -528,7 +586,10 @@ def main() -> int:
         "unit": "tokens/sec",
         "ttft_ms": m["ttft_ms"],
         "tpot_ms": m["tpot_ms"],
+        "queue_wait_ms": m["queue_wait_ms"],
+        "ttft_p99_ms": m["ttft_ms"]["p99"],
         "tpot_p99_ms": m["tpot_ms"]["p99"],
+        "queue_wait_p99_ms": m["queue_wait_ms"]["p99"],
         "decode_tokens_per_sec": round(m["decode_tokens_per_sec"], 2),
         "spec_accept_rate": (
             round(m["spec_accept_rate"], 4)

@@ -359,8 +359,14 @@ def test_serve_cli_fleet_emits_router_metrics_in_one_line():
     assert rec["completed"] == 8
     for key in ("shed", "replayed", "redispatched", "dispatch_retries",
                 "replicas_lost", "detection_latency_s", "queue_depth_max",
-                "steady_per_row_ms"):
+                "steady_per_row_ms",
+                # graft-lens rolling latency summaries
+                "ttft_p99_ms", "queue_wait_p99_ms", "journal_lag_p99_ms",
+                "kv_occupancy_max", "sentinel_triggers"):
         assert key in rec, key
+    assert rec["ttft_p99_ms"] > 0.0
+    assert rec["queue_wait_p99_ms"] > 0.0
+    assert rec["sentinel_triggers"] == []  # clean pass: nothing fired
     assert set(rec["per_replica"]) == {"r0", "r1"}
     for stats in rec["per_replica"].values():
         assert stats["state"] == "stopped"

@@ -387,6 +387,87 @@ def test_serve_real_engine_module_lints_clean():
 
 
 @pytest.mark.lint
+def test_serve_bare_clock_fires_on_direct_and_from_import_calls():
+    src = (
+        "import time\n"
+        "from time import perf_counter as pc\n"
+        "def tick(entry):\n"
+        "    entry.t = time.time()\n"
+        "    dt = pc()\n"
+        "    return dt\n"
+    )
+    findings = pylint_rules.lint_source("serving/router.py", src)
+    assert _rules(findings) == ["serve-bare-clock", "serve-bare-clock"]
+    assert "router.py:4" in findings[0].where
+    assert "router.py:5" in findings[1].where
+
+
+@pytest.mark.lint
+def test_serve_bare_clock_alias_module_and_all_clock_names():
+    src = (
+        "import time as t\n"
+        "def tick():\n"
+        "    a = t.monotonic()\n"
+        "    b = t.perf_counter_ns(), t.monotonic()  # one per line\n"
+        "    return a, b\n"
+    )
+    findings = pylint_rules.lint_source("serving/engine.py", src)
+    assert _rules(findings) == ["serve-bare-clock", "serve-bare-clock"]
+    assert "engine.py:3" in findings[0].where
+    assert "engine.py:4" in findings[1].where
+
+
+@pytest.mark.lint
+def test_serve_bare_clock_quiet_on_injected_clock_and_sleep():
+    # the sanctioned forms: a default-arg REFERENCE (injected clock,
+    # fake-able in tests) and time.sleep (a wait, not a timestamp)
+    src = (
+        "import time\n"
+        "def __init__(self, clock=time.monotonic, sleep=time.sleep):\n"
+        "    self.clock = clock\n"
+        "def pace(self):\n"
+        "    time.sleep(0.01)\n"
+        "    return self.clock()\n"
+    )
+    assert pylint_rules.lint_source("serving/router.py", src) == []
+
+
+@pytest.mark.lint
+def test_serve_bare_clock_scope_and_suppression():
+    src = (
+        "import time\n"
+        "def tick():\n"
+        "    return time.time()\n"
+    )
+    # out of scope: train-side code times steps however it likes
+    assert pylint_rules.lint_source("train/loop.py", src) == []
+    assert pylint_rules.lint_source("telemetry/steptime.py", src) == []
+    src2 = src.replace(
+        "time.time()", "time.time()  # graft-lint: serve-bare-clock"
+    )
+    assert pylint_rules.lint_source("serving/router.py", src2) == []
+
+
+@pytest.mark.lint
+def test_serve_bare_clock_real_serving_modules_clean():
+    # the acceptance gate: every serving module reads time through its
+    # injected clock (or the engine's _ts_us), never a bare module call
+    serving_dir = os.path.join(
+        REPO_ROOT, "distributed_pytorch_example_tpu", "serving"
+    )
+    for fname in sorted(os.listdir(serving_dir)):
+        if not fname.endswith(".py"):
+            continue
+        with open(os.path.join(serving_dir, fname)) as f:
+            src = f.read()
+        findings = [
+            fi for fi in pylint_rules.lint_source(f"serving/{fname}", src)
+            if fi.rule == "serve-bare-clock"
+        ]
+        assert findings == [], [fi.render() for fi in findings]
+
+
+@pytest.mark.lint
 def test_fleet_unbounded_wait_fires_on_bare_waits():
     src = (
         "def pump(inbox, done, worker):\n"

@@ -387,10 +387,78 @@ def test_step_clock_rejects_bad_window():
         StepClock(sample_every=0)
 
 
-def test_exchange_step_times_world_size_one():
+def test_step_clock_first_tick_excludes_warmup(monkeypatch):
+    """Compile/warmup wall time before the first tick must never leak
+    into the first sample: the first tick anchors only, so a 30s compile
+    ahead of it is invisible to step_time_ms."""
+    from distributed_pytorch_example_tpu.telemetry import steptime
+
+    now = {"t": 0.0}
+    monkeypatch.setattr(steptime.time, "perf_counter", lambda: now["t"])
+    clock = StepClock(sample_every=2)
+    now["t"] = 30.0  # a long compile happened before the first tick
+    clock.tick(0, lambda: None)
+    assert clock.step_time_ms is None  # anchored, not sampled
+    now["t"] = 30.020
+    clock.tick(1, lambda: None)
+    now["t"] = 30.040
+    clock.tick(2, lambda: None)
+    # 40 ms over 2 steps: the 30 s of warmup is fully excluded
+    assert clock.step_time_ms == pytest.approx(20.0)
+    # the sample re-anchors the window: the next sample is independent
+    now["t"] = 30.050
+    clock.tick(3, lambda: None)
+    now["t"] = 30.060
+    clock.tick(4, lambda: None)
+    assert clock.step_time_ms == pytest.approx(10.0)
+
+
+def test_exchange_step_times_world_size_one(monkeypatch):
     # single-process contract: no skew fields, and no collective issued
+    from jax.experimental import multihost_utils
+
+    def _boom(*a, **kw):  # pragma: no cover - the point is NOT reached
+        raise AssertionError("collective issued at world size 1")
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", _boom)
     assert exchange_step_times(12.5) == {}
     assert exchange_step_times(None) == {}
+
+
+def test_exchange_step_times_multihost_skew(monkeypatch):
+    """Simulated 4-host gather: skew fields + slow-host list math."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda x: np.asarray([[10.0], [10.0], [12.0], [30.0]], np.float32),
+    )
+    out = exchange_step_times(10.0, skew_threshold=1.5)
+    assert out["step_time_ms_per_host"] == [10.0, 10.0, 12.0, 30.0]
+    assert out["step_time_ms_median_host"] == pytest.approx(11.0)
+    assert out["step_time_ms_max_host"] == pytest.approx(30.0)
+    assert out["step_time_skew"] == pytest.approx(30.0 / 11.0, abs=1e-4)
+    assert out["slow_hosts"] == [3]  # 30 > 1.5 * 11; 12 is not
+
+
+def test_step_profiler_arm_refusal_matrix(tmp_path):
+    """arm() is first-trigger-wins: refuses while a window is pending,
+    refuses windows that are not strictly ahead, no-ops without logdir."""
+    from distributed_pytorch_example_tpu.runtime.profiler import (
+        StepProfiler,
+    )
+
+    assert StepProfiler(None).arm(10, 12) is False  # disabled: no-op
+    p = StepProfiler(str(tmp_path), window=(2, 4))
+    p.step(20)  # drives past the window without opening it
+    assert p.arm(21, 21) is False  # empty window
+    assert p.arm(19, 25) is False  # start not ahead of last step
+    assert p.arm(30, 32) is True
+    assert (p.start_step, p.stop_step) == (30, 32)
+    assert p.arm(40, 42) is False  # pending window: first trigger wins
+    assert (p.start_step, p.stop_step) == (30, 32)
 
 
 def test_trace_writer_valid_json_threads_and_close(tmp_path):
