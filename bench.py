@@ -172,6 +172,66 @@ def _input_plane_probe(batch_np, global_batch, mesh, step_time_s) -> dict:
     }
 
 
+def _shard_cache_probe(cache_mb, mesh, step_time_s) -> dict:
+    """Post-timing graft-intake shard-cache probe (--shard-cache-mb).
+
+    Writes a small sealed shard dataset to a temp dir, pins the memmap
+    pool far below the shard count (so every epoch would re-touch the
+    disk), injects a ``slow-shard-io`` fault at the ``chaos.shard_read``
+    site, and drives two epochs of the real input plane. Epoch 1 decodes
+    from (slow) disk and stalls; epoch 2 serves every row from the
+    in-memory ShardCache — cache hits skip the chaos site along with the
+    disk — so its stall fraction collapsing to ~0 is the cache working,
+    measured end to end through the supervised prefetch worker.
+    """
+    import tempfile
+
+    import numpy as np
+
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.data import streaming
+    from distributed_pytorch_example_tpu.robustness import chaos
+
+    rng = np.random.default_rng(0)
+    shards, rows, hw, batch = 6, 64, 16, 32
+    with tempfile.TemporaryDirectory() as td:
+        streaming.write_image_shards(
+            td,
+            [(rng.integers(0, 256, (rows, hw, hw, 3)).astype(np.uint8),
+              rng.integers(0, 10, (rows,)).astype(np.int64))
+             for _ in range(shards)],
+            shard_size=rows, seal=True,
+        )
+        ds = streaming.StreamingImageShards(
+            td, raw_uint8=True, max_open_shards=2, cache_mb=cache_mb
+        )
+        chaos.install(chaos.ChaosPlan(faults=[chaos.Fault(
+            "slow-shard-io", path_substr="images_",
+            count=10_000, delay_s=0.05,
+        )]))
+        try:
+            fracs = []
+            for _epoch in range(2):
+                loader = dpx.data.DeviceLoader(
+                    ds, batch, mesh=mesh, shuffle=False, prefetch=2,
+                    num_shards=1, shard_id=0,
+                )
+                for _ in loader:
+                    time.sleep(min(step_time_s, 0.02))
+                served = max(loader.batches_served, 1)
+                fracs.append(round(loader.stalled_batches / served, 4))
+        finally:
+            chaos.uninstall()
+    report = {
+        "input_stall_frac_epoch1": fracs[0],
+        "input_stall_frac_epoch2": fracs[1],
+    }
+    stats = ds.cache_stats
+    if stats:
+        report.update(stats)
+    return report
+
+
 def run_serve(args) -> dict:
     """--serve: fixed seeded 32-request replay through the paged-KV
     engine (graft-serve), continuous vs static batching.
@@ -397,9 +457,20 @@ def run_model(name: str, args) -> dict:
         partitioner = dpx.parallel.data_parallel(
             mesh, dp_shard_opt_state=args.zero1
         )
-    # graft-wire: compress the gradient collectives (parallel/wire.py)
+    # graft-wire: compress the gradient collectives (parallel/wire.py);
+    # --overlap-buckets additionally opts the sync into the bucketed
+    # comm/compute-overlap schedule (-1 = the 4 MiB default target)
+    from distributed_pytorch_example_tpu.parallel.wire import (
+        DEFAULT_BUCKET_BYTES,
+    )
+
+    bucket_bytes = (
+        DEFAULT_BUCKET_BYTES if args.overlap_buckets < 0
+        else args.overlap_buckets
+    )
     partitioner.wire = dpx.parallel.WireConfig(
-        compress=args.wire, block_size=args.wire_block
+        compress=args.wire, block_size=args.wire_block,
+        bucket_bytes=bucket_bytes,
     )
     global_batch = batch_per_chip * n_chips
     if batch_per_chip % args.grad_accum:
@@ -477,10 +548,13 @@ def run_model(name: str, args) -> dict:
     if args.auto_mesh:
         # graft-plan: replace the flag-built mesh/partitioner with the
         # static oracle's pick (the batch shapes above are plan-neutral)
-        if pipelined or args.zero1 or args.wire != "none":
+        if (
+            pipelined or args.zero1 or args.wire != "none"
+            or args.overlap_buckets
+        ):
             raise ValueError(
-                "--auto-mesh replaces --mesh-pipe/--zero1/--wire; "
-                "drop those flags"
+                "--auto-mesh replaces --mesh-pipe/--zero1/--wire/"
+                "--overlap-buckets; drop those flags"
             )
         from distributed_pytorch_example_tpu.analysis import (
             envelope,
@@ -596,6 +670,17 @@ def run_model(name: str, args) -> dict:
             print(f"bench: input-plane probe failed: {e}", file=sys.stderr)
             intake_report = None
 
+        cache_report = None
+        if args.shard_cache_mb > 0:
+            try:
+                cache_report = _shard_cache_probe(
+                    args.shard_cache_mb, mesh, elapsed / args.steps
+                )
+            except Exception as e:  # noqa: BLE001 - probe must not kill it
+                print(
+                    f"bench: shard-cache probe failed: {e}", file=sys.stderr
+                )
+
         # graft-lens overlap accounting (post-timing probe, ROADMAP 5(c)):
         # a short XLA trace of the SAME compiled step, split into
         # collective vs compute self time — overlap_frac is the fraction
@@ -666,6 +751,14 @@ def run_model(name: str, args) -> dict:
                 else {}
             ),
             **(
+                {"overlap_buckets": bucket_bytes} if bucket_bytes else {}
+            ),
+            **(
+                {"shard_cache_mb": args.shard_cache_mb}
+                if args.shard_cache_mb
+                else {}
+            ),
+            **(
                 {"flash": args.flash, "remat": args.remat}
                 if flags_apply
                 else {}
@@ -699,6 +792,38 @@ def run_model(name: str, args) -> dict:
             for k, v in overlap_report.items()
             if k != "overlap_frac"
         }
+    # scheduler-level overlap estimate from the static bucket plan
+    # (telemetry/overlap.py scheduled_overlap) — the CI-gateable stand-in
+    # for overlap_frac on CPU where the HLO probe reports null; non-None
+    # only when --overlap-buckets armed the bucketed sync
+    result["overlap_frac_scheduled"] = (
+        trainer.overlap_report["overlap_frac_scheduled"]
+        if trainer.overlap_report else None
+    )
+    if trainer.overlap_report is not None:
+        result["overlap_scheduled"] = {
+            k: trainer.overlap_report[k]
+            for k in (
+                "num_buckets", "hideable_wire_bytes", "total_wire_bytes",
+            )
+        }
+    if args.zero1:
+        # measured HLO collective accounting of the SAME compiled step
+        # (result-buffer proxy, analysis/collectives.py) — the committed
+        # scaling curves (scripts/scaling_sweep.py) plot this against the
+        # analytic graft-prove payload prediction above
+        try:
+            from distributed_pytorch_example_tpu.analysis.collectives import (
+                parse_collectives,
+            )
+
+            result["hlo_collectives"] = parse_collectives(step.as_text())
+        except Exception as e:  # noqa: BLE001 - accounting must not kill it
+            print(f"bench: hlo collective parse failed: {e}", file=sys.stderr)
+    if cache_report is not None:
+        # graft-intake shard-cache evidence: epoch-2 stall collapse +
+        # hit/eviction counters from the end-to-end probe
+        result["shard_cache"] = cache_report
     if chaos_report is not None:
         result["chaos"] = chaos_report
     if intake_report is not None:
@@ -768,6 +893,19 @@ def main():
     parser.add_argument("--wire-block", type=int, default=256,
                         help="elements per bf16 scale block for "
                         "--wire int8-block")
+    parser.add_argument("--overlap-buckets", type=int, default=0,
+                        metavar="BYTES",
+                        help="bucketed comm/compute overlap for the "
+                        "gradient sync (parallel/wire.py sync_grads): "
+                        "target bucket payload bytes; -1 = the 4 MiB "
+                        "default, 0 = the inline per-leaf path")
+    parser.add_argument("--shard-cache-mb", type=int, default=0,
+                        metavar="MB",
+                        help="arm the in-memory decoded-shard cache probe "
+                        "(data/intake.py ShardCache): drives two epochs "
+                        "of the real streaming input plane under a "
+                        "slow-shard-io fault and records the epoch-2 "
+                        "stall fraction collapsing to ~0")
     parser.add_argument("--auto-mesh", action="store_true",
                         help="graft-plan: pick mesh + partitioner per model "
                         "via the static three-tier oracle "

@@ -156,7 +156,7 @@ def legality(plan: PlanSpec, info: ProgramInfo, n_devices: int) -> Optional[str]
             )
     if plan.zero1 and dp <= 1:
         return "zero1 is a no-op without a data span > 1"
-    if plan.wire is not None and plan.wire.active:
+    if plan.wire is not None and plan.wire.compress != "none":
         if dp <= 1:
             return "wire compression is a no-op without a data span > 1"
         if info.max_param_elems and info.max_param_elems < plan.wire.min_size:
@@ -164,7 +164,42 @@ def legality(plan: PlanSpec, info: ProgramInfo, n_devices: int) -> Optional[str]
                 f"wire floor: largest param leaf ({info.max_param_elems} "
                 f"elems) is below min_size {plan.wire.min_size}"
             )
+    if _plan_bucketed(plan) and dp <= 1:
+        return "bucketed overlap is a no-op without a data span > 1"
     return None
+
+
+def _plan_bucketed(plan: PlanSpec) -> bool:
+    """Whether the plan's gradient sync runs the fused bucket schedule."""
+    return plan.bucket_bytes > 0 or (
+        plan.wire is not None and plan.wire.bucketed
+    )
+
+
+def _scheduled_hidden_frac(plan: PlanSpec, data_wire_bytes: float) -> float:
+    """Scheduler-level hidden fraction of the bucketed grad sync.
+
+    Mirrors ``telemetry/overlap.scheduled_overlap`` without needing the
+    leaf tree: K roughly-equal buckets hide the first K-1 behind
+    remaining backward compute, so the hidden fraction is (K-1)/K with
+    K estimated from the traced data-axis wire bytes over the per-bucket
+    wire payload (the fp32 ``bucket_bytes`` target scaled by the wire
+    config's compression factor). Conservative: capped at 0.9 — the
+    link model should never score comm as entirely free.
+    """
+    from distributed_pytorch_example_tpu.parallel import wire as wirelib
+
+    target = plan.bucket_bytes or (
+        plan.wire.bucket_bytes if plan.wire is not None else 0
+    ) or wirelib.DEFAULT_BUCKET_BYTES
+    config = plan.wire or wirelib.WireConfig()
+    # fp32 target -> wire-byte target under the payload compression
+    per_elem = 1.0 + 2.0 / config.block_size if (
+        config.compress == "int8-block"
+    ) else 4.0
+    bucket_wire = max(target * per_elem / 4.0, 1.0)
+    k = max(1, int(round(data_wire_bytes / bucket_wire)))
+    return min(0.9, (k - 1) / k)
 
 
 def _axis_splits(n: int, k: int):
@@ -261,6 +296,7 @@ class PlanScore:
     arg_bytes: int = 0
     cached_config: Optional[str] = None
     cached_comm_ms: Optional[float] = None
+    overlap_hidden_frac: Optional[float] = None
     events_top: List[Dict[str, object]] = dataclasses.field(default_factory=list)
 
     def cost_ms(self) -> float:
@@ -287,6 +323,10 @@ class PlanScore:
             "cached_comm_ms": (
                 None if self.cached_comm_ms is None
                 else round(self.cached_comm_ms, 6)
+            ),
+            "overlap_hidden_frac": (
+                None if self.overlap_hidden_frac is None
+                else round(self.overlap_hidden_frac, 4)
             ),
             # named shardflow events behind the score — `plan_search --diff`
             # attributes ranking flips to these
@@ -372,6 +412,7 @@ def score_flow(
     # tier 1: traced collective wire bytes through the link model
     total_devices = math.prod(mesh_shape.values()) or 1
     axis_bytes: Dict[str, float] = {}
+    grad_sync_ms = 0.0  # event_ms on the data axis (the bucketable sync)
     for e in flow.comm_events():
         span = _span(e.axes, mesh_shape)
         wb = event_wire_bytes(e, span, total_devices)
@@ -385,6 +426,17 @@ def score_flow(
         )
         score.comm_bytes += int(wb)
         score.comm_ms += link.event_ms(wb)
+        if "data" in (str(a) for a in e.axes):
+            grad_sync_ms += link.event_ms(wb)
+    # bucketed plans hide (K-1)/K of the grad-sync wire time behind the
+    # backward segments still computing when early buckets issue
+    # (telemetry/overlap.py scheduled_overlap) — discount the data-axis
+    # comm so --auto-mesh scores overlap instead of treating bucketed and
+    # inline syncs as equal-cost
+    if _plan_bucketed(plan) and grad_sync_ms > 0:
+        hidden = _scheduled_hidden_frac(plan, axis_bytes.get("data", 0.0))
+        score.overlap_hidden_frac = hidden
+        score.comm_ms -= hidden * grad_sync_ms
     score.events_top = [
         e.to_json()
         for e in sorted(
@@ -457,7 +509,7 @@ def match_budget_record(
     except ValueError:
         return None
     sizes = {a: getattr(spec, a) for a in _MESH_AXES}
-    wire_on = plan.wire is not None and plan.wire.active
+    wire_on = plan.wire is not None and plan.wire.compress != "none"
     for name, record in (budgets.get("configs") or {}).items():
         mesh = record.get("mesh")
         if not isinstance(mesh, dict) or {
@@ -467,6 +519,10 @@ def match_budget_record(
         rec_zero1 = "zero1" in name
         rec_wire = record.get("wire") is not None or "wire" in name
         if rec_zero1 != plan.zero1 or rec_wire != wire_on:
+            continue
+        # bucketed and inline syncs compile different collective schedules
+        # (fused per-bucket vs per-leaf) — never cross-match them
+        if ("overlap" in name.split("+")) != _plan_bucketed(plan):
             continue
         rec_gb = record.get("global_batch")
         if (
@@ -539,7 +595,7 @@ def trace_train_plan(
     if unused:
         raise PlanPruned(f"mesh axes {unused} unused by any sharding")
 
-    manual = plan.zero1 or plan.grad_accum > 1 or (
+    manual = plan.zero1 or plan.grad_accum > 1 or _plan_bucketed(plan) or (
         plan.wire is not None and plan.wire.active
     )
     cache_key = plan.name() if manual else ("auto", plan.grad_accum)
@@ -729,7 +785,14 @@ def cli_plan_space(
     """The ``--auto-mesh`` search space shared by train.py / bench.py /
     scripts/plan_search.py: every automatic-mode mesh family (one shared
     trace) plus the zero1 / int8-wire knobs on the pure-DP mesh (one trace
-    each — where bench's --zero1/--wire run), never wire without zero1."""
+    each — where bench's --zero1/--wire run), never wire without zero1.
+    Every pure-DP ZeRO-1 plan also enters in its comm/compute-overlap
+    variant (``bucket_bytes`` at the default target) so the oracle can
+    pick bucketing when the hidden grad-sync time wins."""
+    from distributed_pytorch_example_tpu.parallel.wire import (
+        DEFAULT_BUCKET_BYTES,
+    )
+
     wire = WireConfig(compress="int8-block", block_size=wire_block)
     plans = enumerate_plans(
         n_devices, info,
@@ -738,10 +801,19 @@ def cli_plan_space(
         wire_options=(None, wire),
         allow_pipe=False,
     )
-    return [
+    plans = [
         p for p in plans
         if (p.family == "data" or (not p.zero1 and p.wire is None))
         and (p.wire is None or p.zero1)
+    ]
+    bucketed = [
+        dataclasses.replace(p, bucket_bytes=DEFAULT_BUCKET_BYTES)
+        for p in plans
+        if p.family == "data" and p.zero1
+    ]
+    return plans + [
+        b for b in bucketed
+        if legality(b, info, n_devices) is None
     ]
 
 

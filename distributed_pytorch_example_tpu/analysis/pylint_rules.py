@@ -94,6 +94,20 @@ Twelve rules, each a distilled past-regression class:
   source level before any compile. ``pmean`` (metrics averaging) and
   the ``wire_*`` wrappers themselves are fine.
 
+- ``inline-grad-sync``: a per-leaf wire collective call
+  (``wire_psum_scatter`` / ``wire_all_gather`` / ``wire_psum``) inside
+  ``train/step.py``. The bucketed comm/compute-overlap path
+  (``parallel/wire.py sync_grads``) owns the gradient-sync issue order:
+  buckets launch in reverse trace order on independent dataflow chains
+  so the XLA scheduler hides their wire time behind backward compute. A
+  per-leaf wire call added back to the step is an INLINE collective
+  outside that schedule — it serializes against the whole backward,
+  silently re-creating the exposed-comm ceiling bucketing removed (and
+  the scheduler-level ``overlap_frac`` CI gate would attribute the
+  regression to the wrong bucket). ``sync_grads(...)`` and
+  ``replicate_params(...)`` are the sanctioned entry points; the wire
+  module itself is out of scope.
+
 - ``plan-overlay``: a ``P(...)`` / ``PartitionSpec(...)`` construction
   with a STRING-LITERAL axis name inside ``parallel/api.py`` or
   ``train/step.py``. graft-plan's contract is that every sharding those
@@ -154,6 +168,10 @@ WAIT_SCOPE = ("serving/", "data/")
 # dispatch (parallel/wire.py) — a raw lax.psum*/psum_scatter in the step
 # bypasses the WireConfig compression policy
 WIRE_RAW_SCOPE = ("train/step.py",)
+# inline-grad-sync pins the step's gradient sync to the ONE bucketed
+# dispatcher (parallel/wire.py sync_grads) — a per-leaf wire_* call in
+# the step is an inline collective outside the overlap issue order
+INLINE_GRAD_SYNC_SCOPE = ("train/step.py",)
 # plan-overlay pins the shipped sharding surfaces to the PlanSpec
 # lowering (parallel/plan.py) — a string-literal PartitionSpec in either
 # module is an ad-hoc overlay the static planner cannot score
@@ -441,6 +459,42 @@ def _holds_str_literal(node: ast.AST) -> bool:
     if isinstance(node, (ast.Tuple, ast.List)):
         return any(_holds_str_literal(e) for e in node.elts)
     return False
+
+
+_INLINE_SYNC_NAMES = ("wire_psum_scatter", "wire_all_gather", "wire_psum")
+
+
+def _inline_grad_sync_findings(
+    tree: ast.Module, relpath: str, supp: Dict[int, Set[str]]
+) -> List[Finding]:
+    """Per-leaf wire collective calls bypassing the bucketed sync
+    dispatcher (module docstring: the inline-grad-sync contract)."""
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if name not in _INLINE_SYNC_NAMES:
+            continue
+        if _suppressed(supp, node.lineno, "inline-grad-sync"):
+            continue
+        findings.append(Finding(
+            rule="inline-grad-sync",
+            where=f"{relpath}:{node.lineno}",
+            message=(
+                f"per-leaf {name}(...) in the train step is an inline "
+                "collective outside the bucketed issue order: it "
+                "serializes against the whole backward instead of "
+                "hiding behind it, and its wire time escapes the "
+                "per-bucket overlap attribution — route gradient sync "
+                "through parallel/wire.py sync_grads (replicate_params "
+                "for the ZeRO-1 param gather)"
+            ),
+        ))
+    return findings
 
 
 def _plan_overlay_findings(
@@ -837,6 +891,8 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
         findings.extend(_serve_bare_clock_findings(tree, relpath, supp))
     if _in_scope(relpath, WAIT_SCOPE):
         findings.extend(_fleet_unbounded_wait_findings(tree, relpath, supp))
+    if _in_scope(relpath, INLINE_GRAD_SYNC_SCOPE):
+        findings.extend(_inline_grad_sync_findings(tree, relpath, supp))
     if _in_scope(relpath, PLAN_OVERLAY_SCOPE):
         findings.extend(_plan_overlay_findings(tree, relpath, supp))
     if _in_scope(relpath, DECODE_GATHER_SCOPE):

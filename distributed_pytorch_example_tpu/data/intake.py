@@ -41,6 +41,7 @@ import struct
 import threading
 import time
 import zlib
+from collections import OrderedDict
 from typing import Callable, Iterable, Optional
 
 import numpy as np
@@ -135,6 +136,108 @@ def verify_file(path: str) -> Optional[bool]:
     except (CheckpointCorruptError, OSError, struct.error):
         return False
     return len(data) == size and zlib.crc32(data) == crc
+
+
+# ---------------------------------------------------------------------------
+# in-memory decoded-shard cache (repeated-epoch workloads)
+# ---------------------------------------------------------------------------
+
+
+class ShardCache:
+    """Byte-capped, thread-safe LRU over decoded shard arrays.
+
+    Repeated-epoch workloads re-read every shard once per epoch; when the
+    dataset fits in host RAM that disk + CRC work is pure waste after
+    epoch 1, and it shows up as ``input_stall_frac`` whenever the decode
+    thread falls behind the step. The cache keys decoded row arrays by
+    shard id so epoch >= 2 row reads never touch the disk (or the chaos
+    ``shard_read`` fault site). Quarantine-aware: a shard condemned
+    mid-run must call :meth:`invalidate` so stale rows never keep being
+    served from RAM after the sidecar check rejected the file.
+
+    Thread-safe under one lock — the supervised prefetch worker fills
+    batches off-thread while the main thread quarantines and reads
+    :meth:`stats` (surfaced in bench's JSON line as cache evidence).
+    """
+
+    def __init__(self, capacity_mb: int):
+        if capacity_mb <= 0:
+            raise ValueError(
+                f"ShardCache needs a positive MB cap, got {capacity_mb} "
+                "(callers gate construction on --shard-cache-mb > 0)"
+            )
+        self.capacity_bytes = int(capacity_mb) * 1024 * 1024
+        self._entries: "OrderedDict[object, np.ndarray]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.resident_bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def admits(self, nbytes: int) -> bool:
+        """Whether ``nbytes`` can ever fit — callers skip the decode-to-
+        RAM copy entirely for shards larger than the whole cache."""
+        return 0 < int(nbytes) <= self.capacity_bytes
+
+    def get(self, key) -> Optional[np.ndarray]:
+        """Cached array for ``key`` (refreshing LRU order), else None."""
+        with self._lock:
+            arr = self._entries.get(key)
+            if arr is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return arr
+
+    def put(self, key, arr: np.ndarray) -> bool:
+        """Insert ``arr``, evicting LRU entries until it fits.
+
+        Arrays larger than the cap are refused (returns False) rather
+        than flushing the whole cache for one un-keepable shard.
+        """
+        nbytes = int(getattr(arr, "nbytes", 0))
+        if not self.admits(nbytes):
+            return False
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.resident_bytes -= int(old.nbytes)
+            while (
+                self._entries
+                and self.resident_bytes + nbytes > self.capacity_bytes
+            ):
+                _, evicted = self._entries.popitem(last=False)
+                self.resident_bytes -= int(evicted.nbytes)
+                self.evictions += 1
+            self._entries[key] = arr
+            self.resident_bytes += nbytes
+            return True
+
+    def invalidate(self, key) -> bool:
+        """Drop ``key`` (quarantine hook); True if it was resident."""
+        with self._lock:
+            arr = self._entries.pop(key, None)
+            if arr is None:
+                return False
+            self.resident_bytes -= int(arr.nbytes)
+            return True
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        """Counter snapshot for bench/test evidence."""
+        with self._lock:
+            return {
+                "hits": int(self.hits),
+                "misses": int(self.misses),
+                "evictions": int(self.evictions),
+                "resident_bytes": int(self.resident_bytes),
+                "capacity_bytes": int(self.capacity_bytes),
+                "entries": len(self._entries),
+            }
 
 
 # ---------------------------------------------------------------------------

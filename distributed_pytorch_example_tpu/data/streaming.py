@@ -66,6 +66,13 @@ class StreamingImageShards:
     its samples deterministically onto intact shards), ``"strict"``
     (raise :class:`~..data.intake.ShardCorruptError`), or ``"off"`` (skip
     verification entirely). Unsealed shards are never checked.
+
+    ``cache_mb`` > 0 arms an in-memory decoded-shard cache
+    (:class:`~..data.intake.ShardCache`, the ``--shard-cache-mb`` CLI
+    knob): a shard's rows decode to RAM on first touch and epoch >= 2
+    reads skip the disk and the chaos ``shard_read`` fault site
+    entirely, driving ``input_stall_frac`` to ~0 for datasets that fit
+    the cap. Quarantine invalidates the shard's cache entry.
     """
 
     def __init__(
@@ -76,6 +83,7 @@ class StreamingImageShards:
         max_open_shards: int = 8,
         raw_uint8: bool = False,
         integrity: str = "quarantine",
+        cache_mb: int = 0,
     ):
         if integrity not in _INTEGRITY_MODES:
             raise ValueError(
@@ -116,6 +124,9 @@ class StreamingImageShards:
         self._verified: set = set()
         self._intact_cache: Optional[np.ndarray] = None
         self._open: OrderedDict[int, np.memmap] = OrderedDict()
+        self._cache = (
+            intake.ShardCache(cache_mb) if cache_mb > 0 else None
+        )
 
         lengths = []
         labels = []
@@ -170,8 +181,17 @@ class StreamingImageShards:
         batch = self.get_batch(np.asarray([idx]))
         return {k: v[0] for k, v in batch.items()}
 
-    def _map(self, shard: int) -> np.memmap:
-        """LRU-capped memmap pool; closing a map frees its resident pages."""
+    def _map(self, shard: int) -> np.ndarray:
+        """LRU-capped memmap pool; closing a map frees its resident pages.
+
+        A shard-cache hit (``cache_mb``) returns the decoded in-RAM rows
+        without touching the pool, the disk, or the ``shard_read`` chaos
+        site — the repeated-epoch fast path.
+        """
+        if self._cache is not None:
+            cached = self._cache.get(shard)
+            if cached is not None:
+                return cached
         if shard in self._open:
             self._open.move_to_end(shard)
             return self._open[shard]
@@ -184,7 +204,18 @@ class StreamingImageShards:
         chaos.shard_read(self._image_paths[shard])  # slow-shard-io site
         m = np.load(self._image_paths[shard], mmap_mode="r")
         self._open[shard] = m
+        if self._cache is not None and self._cache.admits(m.nbytes):
+            # decode the whole shard to RAM once; every later epoch's row
+            # reads (and the CRC re-verify on pool eviction) vanish. Must
+            # be a REAL copy — a view would dangle once the LRU pool
+            # force-closes the backing mmap on eviction.
+            self._cache.put(shard, np.array(m, copy=True))
         return m
+
+    @property
+    def cache_stats(self) -> Optional[dict]:
+        """Shard-cache counters (bench evidence), or None when disabled."""
+        return None if self._cache is None else self._cache.stats()
 
     # -- graft-intake: seal verification + quarantine ----------------------
 
@@ -199,6 +230,8 @@ class StreamingImageShards:
         self.quarantined_shards.add(shard)
         self._intact_cache = None
         self._open.pop(shard, None)
+        if self._cache is not None:
+            self._cache.invalidate(shard)
         intake.emit_event(
             "shard_quarantine", shard=int(shard), path=path, reason=reason,
             quarantined=sorted(int(s) for s in self.quarantined_shards),

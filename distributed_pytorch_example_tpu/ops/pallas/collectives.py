@@ -148,7 +148,7 @@ def _ag_kernel(x_ref, out_ref, send_sems, recv_sems, *, axis_name,
         ccw.wait()
 
 
-def ring_all_gather(x, axis_name: str):
+def ring_all_gather(x, axis_name: str, *, stream: int = 0):
     """Tiled axis-0 all-gather along ``axis_name`` via the async
     bidirectional ring — the drop-in shape contract of
     ``lax.all_gather(x, axis_name, axis=0, tiled=True)``. Call inside a
@@ -157,12 +157,20 @@ def ring_all_gather(x, axis_name: str):
     The dispatch boundary carries a ``ring_all_gather`` named scope so
     graft-lens' overlap accounting (telemetry/overlap.py) can attribute
     the moved bytes to this kernel in the XLA trace.
+
+    ``stream`` selects an independent collective buffer set: concurrent
+    ring kernels in one program (the per-bucket gathers of the overlap
+    path, parallel/wire.py sync_grads) MUST carry distinct streams —
+    ``collective_id`` keys the cross-device barrier-semaphore match-up
+    (pallas guide, RDMA section), so two in-flight kernels sharing an id
+    would handshake with each other's barriers. Gathers take the even
+    ids (``2 * stream``), reduce-scatters the odd.
     """
     with jax.named_scope("ring_all_gather"):
-        return _ring_all_gather(x, axis_name)
+        return _ring_all_gather(x, axis_name, stream)
 
 
-def _ring_all_gather(x, axis_name: str):
+def _ring_all_gather(x, axis_name: str, stream: int = 0):
     d = _axis_size(axis_name)
     rows = _half_rows(x.size)
     if d == 1 or rows is None or not ring_supported():
@@ -183,7 +191,9 @@ def _ring_all_gather(x, axis_name: str):
         ),
         out_shape=jax.ShapeDtypeStruct((d,) + halves.shape, x.dtype),
         grid_spec=grid_spec,
-        compiler_params=pltpu.TPUCompilerParams(collective_id=0),
+        compiler_params=pltpu.TPUCompilerParams(
+            collective_id=2 * int(stream)
+        ),
     )(halves)
     return stacked.reshape((d * x.shape[0],) + x.shape[1:])
 
@@ -260,19 +270,26 @@ def _rs_kernel(parts_ref, out_ref, acc_ref, recv_ref, send_sems,
     out_ref[1] = acc_ref[1, last]
 
 
-def ring_reduce_scatter(x, axis_name: str, *, scatter_dimension: int = 0):
+def ring_reduce_scatter(x, axis_name: str, *, scatter_dimension: int = 0,
+                        stream: int = 0):
     """Tiled reduce-scatter via the async bidirectional ring — the
     drop-in contract of ``lax.psum_scatter(..., tiled=True)``, f32
     accumulation. Falls back to the XLA collective off-TPU and for any
     payload the kernel does not cover (chunk not splittable into two
     lane-aligned halves). Dispatch carries a ``ring_reduce_scatter``
     named scope for graft-lens overlap attribution.
+
+    ``stream`` selects an independent collective buffer set (odd
+    ``collective_id`` = ``2 * stream + 1``) so the per-bucket fused
+    reduce-scatters of the overlap path can be in flight concurrently —
+    see :func:`ring_all_gather` for the barrier-semaphore rationale.
     """
     with jax.named_scope("ring_reduce_scatter"):
-        return _ring_reduce_scatter(x, axis_name, scatter_dimension)
+        return _ring_reduce_scatter(x, axis_name, scatter_dimension, stream)
 
 
-def _ring_reduce_scatter(x, axis_name: str, scatter_dimension: int = 0):
+def _ring_reduce_scatter(x, axis_name: str, scatter_dimension: int = 0,
+                         stream: int = 0):
     d = _axis_size(axis_name)
     if (
         d == 1
@@ -317,6 +334,8 @@ def _ring_reduce_scatter(x, axis_name: str, scatter_dimension: int = 0):
         ),
         out_shape=jax.ShapeDtypeStruct((2, rows, _LANES), jnp.float32),
         grid_spec=grid_spec,
-        compiler_params=pltpu.TPUCompilerParams(collective_id=1),
+        compiler_params=pltpu.TPUCompilerParams(
+            collective_id=2 * int(stream) + 1
+        ),
     )(halves)
     return out.reshape(chunk_shape).astype(x.dtype)

@@ -56,6 +56,14 @@ class PlanSpec:
     microbatches. ``schedule`` is informational (the pipeline runner is
     selected by the caller, not the partitioner) but participates in plan
     naming/legality so the planner can reason about 1F1B stash memory.
+
+    ``bucket_bytes`` > 0 opts the gradient sync into the fused
+    comm/compute-overlap bucket schedule (``parallel/wire.py
+    sync_grads``): ``lower()`` merges it into the wire config (creating a
+    compression-free ``WireConfig`` when ``wire`` is None), so bucketing
+    is a plan-level knob the planner can score (``LinkModel`` discounts
+    hidden grad-sync time for bucketed plans) and ``--overlap-buckets``
+    can set from the CLI without touching the wire payload choice.
     """
 
     mesh: MeshSpec = MeshSpec()
@@ -67,6 +75,7 @@ class PlanSpec:
     grad_accum: int = 1
     wire: Optional[WireConfig] = None
     schedule: Optional[str] = None
+    bucket_bytes: int = 0
 
     # -- lowering ----------------------------------------------------------
 
@@ -84,13 +93,20 @@ class PlanSpec:
         if mesh is None:
             mesh = make_mesh(self.mesh, devices=devices)
         rules, default = self._rules_for(mesh)
+        wire = self.wire
+        if self.bucket_bytes > 0:
+            # bucketing is a plan knob, payload choice a wire knob — merge
+            # here so the partitioner sees ONE effective WireConfig
+            wire = dataclasses.replace(
+                wire or WireConfig(), bucket_bytes=self.bucket_bytes
+            )
         return Partitioner(
             mesh,
             rules=rules,
             default=default,
             dp_shard_opt_state=self.zero1,
             opt_shard_min_size=self.opt_shard_min_size,
-            wire=self.wire,
+            wire=wire,
         )
 
     def _rules_for(self, mesh: Mesh):
@@ -144,8 +160,12 @@ class PlanSpec:
             parts.append("rest-fsdp")
         if self.zero1:
             parts.append("zero1")
-        if self.wire is not None and self.wire.active:
+        if self.wire is not None and self.wire.compress != "none":
             parts.append(self.wire.compress)
+        if self.bucket_bytes > 0 or (
+            self.wire is not None and self.wire.bucketed
+        ):
+            parts.append("overlap")
         if self.grad_accum > 1:
             parts.append(f"ga{self.grad_accum}")
         if self.schedule:
@@ -163,6 +183,7 @@ class PlanSpec:
             "grad_accum": self.grad_accum,
             "wire": dataclasses.asdict(self.wire) if self.wire else None,
             "schedule": self.schedule,
+            "bucket_bytes": self.bucket_bytes,
         }
         return d
 

@@ -58,7 +58,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -93,6 +93,13 @@ def _scoped(name: str):
 # (same floor rationale as parallel/api.py DEFAULT_OPT_SHARD_MIN_SIZE)
 DEFAULT_MIN_SIZE = 2048
 
+# default size target (bytes of fp32 gradient) for one comm/compute
+# overlap bucket when bucketing is requested without an explicit size —
+# the same order as DDP's bucket_cap_mb=25 scaled to the payloads our
+# dryrun/test models move (reference train.py:233: DDP's bucketed
+# backward hooks are exactly this partitioning)
+DEFAULT_BUCKET_BYTES = 4 * 1024 * 1024
+
 
 @dataclasses.dataclass(frozen=True)
 class WireConfig:
@@ -106,6 +113,15 @@ class WireConfig:
     the default); ``ring`` gates the Pallas async ring kernels ("auto"
     uses them where they lower, "off" forces the XLA collectives);
     ``min_size`` is the element floor below which leaves keep fp32.
+
+    ``bucket_bytes`` > 0 switches the gradient sync from one collective
+    per param leaf to FUSED size-targeted buckets (``plan_buckets`` /
+    ``sync_grads``): leaves are concatenated in reverse trace order and
+    each bucket moves as ONE collective with an independent dataflow
+    chain, so the XLA latency-hiding scheduler can issue bucket k's
+    reduce-scatter while the backward segment producing bucket k+1 is
+    still computing — the comm/compute overlap DDP's bucketed hooks get
+    for free. 0 (the default) keeps the inline per-leaf path.
     """
 
     compress: str = "none"
@@ -114,6 +130,7 @@ class WireConfig:
     param_gather: str = "float32"
     ring: str = "auto"
     min_size: int = DEFAULT_MIN_SIZE
+    bucket_bytes: int = 0
 
     def __post_init__(self):
         if self.compress not in COMPRESS_MODES:
@@ -135,11 +152,25 @@ class WireConfig:
             raise ValueError(
                 f"WireConfig.block_size must be >= 1, got {self.block_size}"
             )
+        if self.bucket_bytes < 0:
+            raise ValueError(
+                f"WireConfig.bucket_bytes must be >= 0, got "
+                f"{self.bucket_bytes}"
+            )
 
     @property
     def active(self) -> bool:
         """Whether any wire surface differs from the raw collectives."""
-        return self.compress != "none" or self.param_gather != "float32"
+        return (
+            self.compress != "none"
+            or self.param_gather != "float32"
+            or self.bucketed
+        )
+
+    @property
+    def bucketed(self) -> bool:
+        """Whether gradient sync runs the fused bucketed issue path."""
+        return self.bucket_bytes > 0
 
     def compresses(self, n_elements: int) -> bool:
         """Whether a leaf of this many elements gets the int8 payload."""
@@ -330,16 +361,329 @@ def wire_psum(x, axis_name: str, *,
 
 
 def _gather(x, axis_name: str, gather_dimension: int,
-            config: WireConfig):
-    """Tiled all-gather, through the Pallas async ring where it lowers."""
+            config: WireConfig, stream: int = 0):
+    """Tiled all-gather, through the Pallas async ring where it lowers.
+
+    ``stream`` selects the ring kernel's collective buffer set (one per
+    overlap bucket) so concurrent bucketed gathers never share barrier
+    semaphores — see ``ops/pallas/collectives.py``.
+    """
     if config.ring != "off" and gather_dimension == 0:
         from distributed_pytorch_example_tpu.ops.pallas import (
             collectives as ring,
         )
 
         if ring.ring_supported():
-            return ring.ring_all_gather(x, axis_name)
+            return ring.ring_all_gather(x, axis_name, stream=stream)
     return lax.all_gather(x, axis_name, axis=gather_dimension, tiled=True)
+
+
+# -- bucketed gradient sync (comm/compute overlap) -------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One fused gradient-sync bucket (static — shapes only).
+
+    ``kind`` is ``"scatter"`` (every leaf has a ZeRO-1 scatter dim; the
+    bucket moves as one fused reduce-scatter) or ``"psum"`` (unsharded
+    leaves; one fused all-reduce). ``leaves`` are flat
+    ``tree_leaves``-order indices into the gradient tree; ``elements``
+    the bucket's total element count; ``fp32_bytes`` its size metric
+    (4 B/element, the pre-compression payload the size target governs);
+    ``wire_bytes`` the analytic per-device ring payload of the bucket's
+    collective(s) under the config that planned it.
+    """
+
+    index: int
+    kind: str
+    leaves: Tuple[int, ...]
+    elements: int
+    fp32_bytes: int
+    wire_bytes: int
+
+    def to_json(self) -> dict:
+        return {
+            "index": self.index,
+            "kind": self.kind,
+            "num_leaves": len(self.leaves),
+            "elements": self.elements,
+            "fp32_bytes": self.fp32_bytes,
+            "wire_bytes": self.wire_bytes,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """The static bucket schedule ``sync_grads`` executes.
+
+    ``buckets`` are in ISSUE ORDER: reverse trace order over the leaf
+    list, because the backward pass produces the LAST layers' gradients
+    first — bucket 0's collective can therefore launch while the
+    backward segments feeding later buckets are still computing (the
+    DDP bucketed-hook issue order, reference train.py:233). Purely a
+    function of shapes + config, so the step build, the analytic
+    reports, and the tests all derive the identical plan.
+    """
+
+    buckets: Tuple[Bucket, ...]
+    bucket_bytes: int
+    axis_size: int
+
+    def to_json(self) -> dict:
+        return {
+            "bucket_bytes": self.bucket_bytes,
+            "axis_size": self.axis_size,
+            "num_buckets": len(self.buckets),
+            "buckets": [b.to_json() for b in self.buckets],
+        }
+
+
+def plan_buckets(dims, grads, config: WireConfig, axis_size: int,
+                 bucket_bytes: Optional[int] = None) -> BucketPlan:
+    """Greedy size-targeted bucket assignment over gradient leaves.
+
+    Walks the flat leaf list in REVERSE trace order (the order backward
+    produces gradients), appending each leaf to the open bucket of its
+    kind (scatterable vs unsharded) and sealing the bucket once its
+    fp32 size reaches ``bucket_bytes``. Scatterable and unsharded
+    leaves never share a bucket — they move through different
+    collectives. Static: ``grads`` only needs ``.shape``/``.size``
+    (ShapeDtypeStructs work), so the planner and telemetry reports run
+    this without a backend.
+    """
+    if bucket_bytes is None:
+        bucket_bytes = config.bucket_bytes or DEFAULT_BUCKET_BYTES
+    is_dim_leaf = lambda d: d is None  # noqa: E731 - tree of Optional[int]
+    dim_leaves = jax.tree_util.tree_leaves(dims, is_leaf=is_dim_leaf)
+    leaves = jax.tree_util.tree_leaves(grads)
+    if len(dim_leaves) != len(leaves):
+        raise ValueError(
+            f"dims/grads leaf mismatch: {len(dim_leaves)} vs {len(leaves)}"
+        )
+    d = max(int(axis_size), 1)
+    ring_factor = (d - 1) / d if d > 1 else 0.0
+    buckets = []
+    open_leaves: dict = {"scatter": [], "psum": []}
+    open_elems: dict = {"scatter": 0, "psum": 0}
+
+    def seal(kind: str) -> None:
+        ids = open_leaves[kind]
+        if not ids:
+            return
+        n = open_elems[kind]
+        passes = 1.0 if kind == "scatter" else 2.0  # RS vs AR (RS + AG)
+        wire = passes * ring_factor * n * _bytes_per_element(config, n)
+        buckets.append(Bucket(
+            index=len(buckets), kind=kind, leaves=tuple(ids),
+            elements=n, fp32_bytes=n * 4, wire_bytes=int(round(wire)),
+        ))
+        open_leaves[kind] = []
+        open_elems[kind] = 0
+
+    for i in reversed(range(len(leaves))):
+        n = int(getattr(leaves[i], "size", 0) or 0)
+        if n == 0:
+            continue
+        kind = "scatter" if dim_leaves[i] is not None else "psum"
+        open_leaves[kind].append(i)
+        open_elems[kind] += n
+        if open_elems[kind] * 4 >= bucket_bytes:
+            seal(kind)
+    seal("scatter")
+    seal("psum")
+    return BucketPlan(
+        buckets=tuple(buckets), bucket_bytes=int(bucket_bytes),
+        axis_size=d,
+    )
+
+
+def _scatter_parts(g, dim: int, d: int):
+    """((d, n/d) destination-major rows, per-shard chunk shape) of one
+    scatterable leaf — row j is the flattened chunk bound for shard j,
+    and the chunk shape IS the tiled ``psum_scatter`` output shape."""
+    chunk = g.shape[dim] // d
+    parts = jnp.moveaxis(
+        g.reshape(g.shape[:dim] + (d, chunk) + g.shape[dim + 1:]), dim, 0
+    )
+    return parts.reshape(d, -1), parts.shape[1:]
+
+
+def _reduce_scatter_rows(buf, axis_name: str, config: WireConfig,
+                         stream: int) -> Any:
+    """Fused fp32 reduce-scatter of a (d, n/d) destination-major buffer
+    -> this shard's reduced (n/d,) row, via the Pallas async ring where
+    it lowers (one buffer set per ``stream``)."""
+    if config.ring != "off":
+        from distributed_pytorch_example_tpu.ops.pallas import (
+            collectives as ring,
+        )
+
+        if ring.ring_supported():
+            return ring.ring_reduce_scatter(
+                buf, axis_name, scatter_dimension=0, stream=stream
+            ).reshape(-1)
+    return lax.psum_scatter(
+        buf, axis_name, scatter_dimension=0, tiled=True
+    ).reshape(-1)
+
+
+def _bucket_scatter(out, leaves, dim_leaves, bucket: Bucket,
+                    axis_name: str, d: int, config: WireConfig, key,
+                    scale: float) -> None:
+    """Execute one fused scatter bucket: canonicalize every leaf to
+    destination-major (d, n_i/d) rows, concatenate along the row, move
+    the whole bucket through ONE collective, split the reduced row back
+    per leaf. Quantization (when the bucket clears ``min_size``) runs
+    on the concatenated buffer, so block boundaries span leaf joins —
+    the parity contract is the test_zero1 trajectory bars, not
+    bit-identity with the per-leaf path."""
+    parts, chunk_shapes = [], []
+    for i in bucket.leaves:
+        rows, cs = _scatter_parts(leaves[i], dim_leaves[i], d)
+        parts.append(rows)
+        chunk_shapes.append(cs)
+    buf = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+    nb = buf.shape[1]
+    if config.compresses(bucket.elements):
+        rows, _ = _pad_rows(buf, config.block_size)
+        q, scales = _quantize_rows(
+            rows, config.block_size,
+            key if config.stochastic_rounding else None,
+        )
+        q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+        scales = lax.all_to_all(
+            scales, axis_name, split_axis=0, concat_axis=0
+        )
+        red = jnp.sum(_dequantize_rows(q, scales, nb), axis=0)
+    else:
+        red = _reduce_scatter_rows(buf, axis_name, config, bucket.index)
+    red = red * scale
+    offset = 0
+    for i, cs in zip(bucket.leaves, chunk_shapes):
+        n_i = 1
+        for s in cs:
+            n_i *= int(s)
+        out[i] = red[offset:offset + n_i].reshape(cs)
+        offset += n_i
+
+
+def _bucket_psum(out, leaves, bucket: Bucket, axis_name: str, d: int,
+                 config: WireConfig, key, scale: float) -> None:
+    """Execute one fused all-reduce bucket over the unsharded leaves:
+    concatenate flattened leaves, one psum (or the quantized RS + AG
+    decomposition of ``wire_psum``) over the joined buffer, split back."""
+    flats = [leaves[i].reshape(-1) for i in bucket.leaves]
+    flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+    n = flat.size
+    if config.compresses(bucket.elements):
+        k1, k2 = _split_key(
+            key if config.stochastic_rounding else None, 2
+        )
+        padded = flat
+        pad = (-n) % d
+        if pad:
+            padded = jnp.pad(padded, (0, pad))
+        chunks = padded.reshape(d, -1)
+        rows, _ = _pad_rows(chunks, config.block_size)
+        q, scales = _quantize_rows(rows, config.block_size, k1)
+        q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+        scales = lax.all_to_all(
+            scales, axis_name, split_axis=0, concat_axis=0
+        )
+        reduced = jnp.sum(
+            _dequantize_rows(q, scales, chunks.shape[1]), axis=0
+        )
+        rows2, _ = _pad_rows(reduced[None], config.block_size)
+        q2, scales2 = _quantize_rows(rows2, config.block_size, k2)
+        q2 = _gather(q2, axis_name, 0, config, stream=bucket.index)
+        scales2 = _gather(
+            scales2, axis_name, 0, config, stream=bucket.index
+        )
+        full = _dequantize_rows(
+            q2, scales2, chunks.shape[1]
+        ).reshape(-1)
+        if pad:
+            full = full[:n]
+    else:
+        full = lax.psum(flat, axis_name)
+    full = full * scale
+    offset = 0
+    for i in bucket.leaves:
+        leaf = leaves[i]
+        out[i] = full[offset:offset + leaf.size].reshape(leaf.shape)
+        offset += leaf.size
+
+
+def sync_grads(grads, dims, axis_name: str, *,
+               config: Optional[WireConfig] = None, key=None,
+               scale: float = 1.0,
+               plan: Optional[BucketPlan] = None):
+    """THE gradient-sync dispatcher for the data-manual train step.
+
+    ``train/step.py`` must route every gradient collective through this
+    one entry point (the ``inline-grad-sync`` graft-lint rule pins it):
+    leaves with a ZeRO-1 scatter dim in ``dims`` reduce-scatter into
+    the sharded-update layout, the rest all-reduce, every payload per
+    the ``WireConfig``, and the result is scaled by ``scale`` (the
+    global-mean factor).
+
+    With ``config.bucket_bytes == 0`` this is the historical inline
+    path — one collective per leaf, per-leaf stochastic-rounding keys in
+    trace order — byte-identical to the pre-bucketing step. With a
+    bucket size it executes :func:`plan_buckets`'s fused schedule: each
+    bucket is one named-scope-stamped collective with its own dataflow
+    chain (``wire_bucket<k>``), issued in reverse-trace order so the
+    XLA latency-hiding scheduler interleaves bucket k's wire time with
+    the backward compute that produces bucket k+1 — and graft-lens'
+    overlap accounting (telemetry/overlap.py) attributes the hidden
+    bytes per bucket by those scopes.
+    """
+    config = config or WireConfig()
+    is_dim_leaf = lambda d: d is None  # noqa: E731 - tree of Optional[int]
+    if not config.bucketed:
+        leaf_idx = [0]  # trace-order leaf counter for per-leaf keys
+
+        def sync(dim, g):
+            k = None
+            if key is not None:
+                k = jax.random.fold_in(key, leaf_idx[0])
+            leaf_idx[0] += 1
+            if dim is not None:
+                g = wire_psum_scatter(
+                    g, axis_name, scatter_dimension=dim, config=config,
+                    key=k,
+                )
+            else:
+                g = wire_psum(g, axis_name, config=config, key=k)
+            return g * scale
+
+        return jax.tree_util.tree_map(
+            sync, dims, grads, is_leaf=is_dim_leaf
+        )
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    dim_leaves = jax.tree_util.tree_leaves(dims, is_leaf=is_dim_leaf)
+    d = _axis_size(axis_name)
+    if plan is None:
+        plan = plan_buckets(dims, grads, config, d)
+    out: list = list(leaves)  # zero-size leaves pass through unsynced
+    for bucket in plan.buckets:
+        bkey = None if key is None else jax.random.fold_in(
+            key, bucket.index
+        )
+        with jax.named_scope(f"wire_bucket{bucket.index}"):
+            if bucket.kind == "scatter":
+                _bucket_scatter(
+                    out, leaves, dim_leaves, bucket, axis_name, d,
+                    config, bkey, scale,
+                )
+            else:
+                _bucket_psum(
+                    out, leaves, bucket, axis_name, d, config, bkey,
+                    scale,
+                )
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 # -- ZeRO-1 param re-replication ------------------------------------------

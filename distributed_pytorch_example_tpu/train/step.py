@@ -33,12 +33,17 @@ tiled reshard as all-reduce + dynamic-slice (the CPU backend always does;
 TPU needs the ReduceScatterCreator pass to fire), whereas the explicit
 collective IS a reduce-scatter in the compiled HLO on every backend.
 
-Both explicit collectives route through ``parallel/wire.py`` (graft-wire):
-a ``WireConfig`` threaded from the partitioner (or passed directly)
-selects fp32 payloads (default, byte-identical to the raw ``lax``
-collectives) or int8-block compression, for the ZeRO-1 reduce-scatter AND
-the plain-DP psum fallback alike. The ``wire-raw-collective`` graft-lint
-rule pins the dispatch: this module must not call ``lax.psum*`` directly.
+All gradient collectives route through ``parallel/wire.py``'s ONE
+dispatcher, ``sync_grads`` (graft-wire): a ``WireConfig`` threaded from
+the partitioner (or passed directly) selects fp32 payloads (default,
+byte-identical to the raw ``lax`` collectives) or int8-block compression,
+for the ZeRO-1 reduce-scatter AND the plain-DP psum fallback alike — and
+``bucket_bytes > 0`` switches the sync to fused size-targeted buckets
+issued in reverse trace order so the collectives overlap backward compute
+(comm/compute overlap, the DDP-bucketed-hooks analogue). Two graft-lint
+rules pin the dispatch: ``wire-raw-collective`` (no raw ``lax.psum*``
+here) and ``inline-grad-sync`` (no per-leaf ``wire_psum_scatter`` /
+``wire_all_gather`` calls here either — only ``sync_grads``).
 """
 
 from __future__ import annotations
@@ -213,14 +218,15 @@ def build_train_step(
         wire = getattr(partitioner, "wire", None) or wirelib.WireConfig()
     zero1 = bool(partitioner is not None and partitioner.dp_shard_opt_state)
     wire_active = wire.compress != "none"
-    # All three modes need the data axis MANUAL: ZeRO-1 for the explicit
+    # All four modes need the data axis MANUAL: ZeRO-1 for the explicit
     # reduce-scatter, accumulation so the per-microbatch backward carries
     # no implicit data collective inside the scan (XLA's while-loop
     # all-reduce motion would have to hoist it; manual mode never emits
-    # it), and wire compression because only the explicit collective can
-    # carry an int8 payload
+    # it), wire compression because only the explicit collective can
+    # carry an int8 payload, and bucketing because the fused per-bucket
+    # issue order only exists as explicit collectives
     manual_data = partitioner is not None and (
-        zero1 or grad_accum_steps > 1 or wire_active
+        zero1 or grad_accum_steps > 1 or wire_active or wire.bucketed
     )
 
     def compute_loss_grads(params, model_state, batch, rng):
@@ -308,34 +314,21 @@ def build_train_step(
                     params, model_state, batch, rng
                 )
 
-            # the ONE deferred gradient collective per step: local grads
-            # are d(local mean loss), so the global mean gradient is
-            # psum(...) / (data span * microbatch count). Payload per the
-            # WireConfig — fp32 collapses to the raw lax collective.
+            # the ONE deferred gradient sync per step: local grads are
+            # d(local mean loss), so the global mean gradient is
+            # psum(...) / (data span * microbatch count). ALL gradient
+            # collectives go through sync_grads (the inline-grad-sync
+            # lint rule pins this) — per-leaf collectives when
+            # bucket_bytes == 0, the fused reverse-trace-order bucket
+            # schedule otherwise, payload per the WireConfig either way.
             scale = 1.0 / (dsize * grad_accum_steps)
             wire_rng = (
                 jax.random.fold_in(rng, 0x77697265)  # b"wire"
                 if wire.stochastic_rounding and wire_active
                 else None
             )
-            leaf_idx = [0]  # trace-order leaf counter for per-leaf keys
-
-            def sync(dim, g):
-                key = None
-                if wire_rng is not None:
-                    key = jax.random.fold_in(wire_rng, leaf_idx[0])
-                leaf_idx[0] += 1
-                if dim is not None:
-                    g = wirelib.wire_psum_scatter(
-                        g, axis, scatter_dimension=dim, config=wire,
-                        key=key,
-                    )
-                else:
-                    g = wirelib.wire_psum(g, axis, config=wire, key=key)
-                return g * scale
-
-            grads = jax.tree_util.tree_map(
-                sync, dims, grads, is_leaf=is_dim_leaf
+            grads = wirelib.sync_grads(
+                grads, dims, axis, config=wire, key=wire_rng, scale=scale
             )
             # loss/accuracy become means over the GLOBAL batch (equal
             # shard sizes by the sampler's padding contract — same

@@ -190,6 +190,22 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         "all-gather; float32 keeps master weights exact "
                         "(lossy modes are opt-in — the gathered buffer "
                         "feeds the next update)")
+    parser.add_argument("--overlap-buckets", type=int, default=0,
+                        metavar="BYTES",
+                        help=">0: fused comm/compute-overlap gradient sync "
+                        "— grad leaves bucket to ~BYTES of fp32 each "
+                        "(reverse trace order) and each bucket moves as "
+                        "ONE collective the XLA scheduler hides behind "
+                        "backward compute (parallel/wire.py sync_grads; "
+                        "composes with --zero1/--wire). -1 = the default "
+                        "4 MiB target; 0 = inline per-leaf sync")
+    parser.add_argument("--shard-cache-mb", type=int, default=0,
+                        metavar="MB",
+                        help=">0: graft-intake in-memory LRU over decoded "
+                        "sealed shards, capped at MB; repeated-epoch "
+                        "workloads stop paying disk reads + CRC verify "
+                        "from epoch 2 (input_stall_frac -> ~0). "
+                        "Quarantined shards are evicted. 0 = off")
     parser.add_argument("--max-bad-steps", type=int, default=8,
                         help="nonfinite steps skipped device-side before "
                         "rolling back to the last good checkpoint (a second "

@@ -25,7 +25,15 @@ line embedded in the tail) and a raw bench.py stdout line are accepted on
 either side. Models present on only one side are reported but do not fail
 the gate (new models have no baseline; removed models are a visible note).
 
-This gate covers RUNTIME throughput only; its static sibling is
+The gate also learns the committed dp-scaling curves
+(``results/scaling/scaling.json``, written by
+``scripts/scaling_sweep.py``): any BASELINE model whose weak-scaling
+efficiency at any committed world size falls below ``--scaling-floor``
+(default 90%) fails, named by (model, world size, mode). Like the noise
+floor it is a committed artifact — refresh it with a fresh sweep in the
+same commit as a deliberate wire/overlap schedule change.
+
+Beyond that, this gate covers RUNTIME throughput only; its static sibling is
 ``scripts/graft_lint.py``, which gates compiled-HLO collective
 counts/bytes against the committed ``analysis/comm_budgets.json``. The
 budget file is a committed artifact like ``BENCH_r*.json`` and goes stale
@@ -110,6 +118,14 @@ def main() -> int:
                         help="per-model noise floor json (default: "
                         "results/bench_noise/noise.json when present; "
                         "'' disables)")
+    parser.add_argument("--scaling", default=None,
+                        help="committed dp-scaling curves json (default: "
+                        "results/scaling/scaling.json when present; "
+                        "'' disables the scaling gate)")
+    parser.add_argument("--scaling-floor", type=float, default=0.90,
+                        help="minimum committed dp-scaling efficiency "
+                        "for BASELINE models at every world size "
+                        "(0.90 = 90%%)")
     args = parser.parse_args()
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -175,6 +191,35 @@ def main() -> int:
                 line += f"  CONFIG CHANGED {diffs} — delta not comparable"
         report.append(line)
 
+    # dp-scaling gate: the committed scaling.json curves
+    # (scripts/scaling_sweep.py) are a shipping artifact like BENCH_r*;
+    # a BASELINE model whose committed efficiency sags below the floor at
+    # ANY world size means the last sweep measured the gradient sync
+    # eating the mesh — fail by (model, world size) so the regression is
+    # attributable before it ships
+    scaling_path = args.scaling
+    if scaling_path is None:
+        cand = os.path.join(root, "results", "scaling", "scaling.json")
+        scaling_path = cand if os.path.exists(cand) else ""
+    if scaling_path:
+        with open(scaling_path) as f:
+            scaling = json.load(f)
+        baseline_models = set(scaling.get("baseline_models", []))
+        for model, mc in sorted(scaling.get("models", {}).items()):
+            if model not in baseline_models:
+                continue
+            for mode, curve in sorted(mc.get("modes", {}).items()):
+                for w, eff in sorted(
+                    curve.get("efficiency", {}).items(), key=lambda kv:
+                    int(kv[0]),
+                ):
+                    line = (f"  scaling {model}/{mode} W={w}: "
+                            f"{eff:.1%} (floor {args.scaling_floor:.0%})")
+                    if eff < args.scaling_floor:
+                        failures.append(f"{model} (W={w}, {mode})")
+                        line += "  REGRESSION (dp-scaling below floor)"
+                    report.append(line)
+
     # graft-plan advisory (warn, never fail — mirrors the jax-version-skew
     # demotion of the comm budgets): a stale analysis/plans.json means the
     # committed --auto-mesh rankings were computed against a collective
@@ -198,7 +243,7 @@ def main() -> int:
     print("\n".join(report), file=sys.stderr)
     if failures:
         print(
-            f"bench_gate: FAIL — throughput regression in: "
+            f"bench_gate: FAIL — throughput/scaling regression in: "
             f"{', '.join(failures)}. Fix or revert before shipping "
             f"(see VERDICT r3 #1 for why this gate exists).",
             file=sys.stderr,

@@ -41,12 +41,13 @@ def _payload(models):
 def _run_gate(prev, cur, tmp_path, extra=()):
     prev_path = tmp_path / "prev.json"
     prev_path.write_text(json.dumps(prev))
-    # --noise '' keeps these hermetic: without it the gate auto-discovers
-    # the repo's committed results/bench_noise/noise.json and these
-    # fixture models would pick up the real per-model tolerances
+    # --noise '' / --scaling '' keep these hermetic: without them the
+    # gate auto-discovers the repo's committed results/bench_noise and
+    # results/scaling artifacts and these fixture models would pick up
+    # the real per-model tolerances and curves
     proc = subprocess.run(
         [sys.executable, GATE, "--prev", str(prev_path), "--noise", "",
-         *extra],
+         "--scaling", "", *extra],
         input=json.dumps(cur), capture_output=True, text=True,
     )
     return proc.returncode, proc.stderr
@@ -223,3 +224,66 @@ def test_not_a_bench_payload(tmp_path):
         input="{}", capture_output=True, text=True,
     )
     assert proc.returncode != 0
+
+
+def _scaling_artifact(eff_by_world, model="resnet18", mode="overlap"):
+    return {
+        "kind": "dp-weak-scaling",
+        "host_multiplexed": True,
+        "world_sizes": sorted(int(w) for w in eff_by_world),
+        "baseline_models": [model],
+        "models": {
+            model: {"modes": {mode: {"efficiency": eff_by_world}}}
+        },
+    }
+
+
+def test_scaling_curve_below_floor_fails_by_model_and_world(tmp_path):
+    """A committed dp-scaling curve sagging below the floor fails the
+    gate naming (model, world size) — the ISSUE-19 acceptance gate."""
+    scaling_path = tmp_path / "scaling.json"
+    scaling_path.write_text(json.dumps(_scaling_artifact(
+        {"1": 1.0, "2": 0.97, "4": 0.95, "8": 0.84}
+    )))
+    prev = _payload({"resnet50": _model("resnet50", 1000.0)})
+    cur = _payload({"resnet50": _model("resnet50", 1000.0)})
+    rc, err = _run_gate(
+        prev, cur, tmp_path, extra=("--scaling", str(scaling_path)),
+    )
+    assert rc == 1
+    assert "resnet18 (W=8, overlap)" in err
+    assert "dp-scaling below floor" in err
+    assert "W=4" not in err.split("FAIL")[-1]  # only W=8 named as failing
+
+
+def test_scaling_curve_above_floor_passes_and_reports(tmp_path):
+    scaling_path = tmp_path / "scaling.json"
+    scaling_path.write_text(json.dumps(_scaling_artifact(
+        {"1": 1.0, "2": 0.99, "4": 0.96, "8": 0.93}
+    )))
+    prev = _payload({"resnet50": _model("resnet50", 1000.0)})
+    cur = _payload({"resnet50": _model("resnet50", 1000.0)})
+    rc, err = _run_gate(
+        prev, cur, tmp_path, extra=("--scaling", str(scaling_path)),
+    )
+    assert rc == 0, err
+    assert "scaling resnet18/overlap W=8" in err  # curve visible in report
+
+
+def test_scaling_floor_flag_and_non_baseline_models_advisory(tmp_path):
+    """--scaling-floor moves the bar; models not in baseline_models are
+    exempt (experimental zoo entries don't gate)."""
+    art = _scaling_artifact({"1": 1.0, "8": 0.85})
+    art["models"]["llama-exp"] = {
+        "modes": {"overlap": {"efficiency": {"1": 1.0, "8": 0.5}}}
+    }
+    scaling_path = tmp_path / "scaling.json"
+    scaling_path.write_text(json.dumps(art))
+    prev = _payload({"resnet50": _model("resnet50", 1000.0)})
+    cur = _payload({"resnet50": _model("resnet50", 1000.0)})
+    rc, err = _run_gate(
+        prev, cur, tmp_path,
+        extra=("--scaling", str(scaling_path), "--scaling-floor", "0.80"),
+    )
+    assert rc == 0, err
+    assert "llama-exp" not in err

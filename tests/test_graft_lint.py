@@ -570,17 +570,61 @@ def test_wire_raw_collective_scope_suppression_and_lookalikes():
         "lax.psum(g, 'data')  # graft-lint: wire-raw-collective",
     )
     assert pylint_rules.lint_source("train/step.py", supp) == []
-    # the sanctioned spellings never fire: the wire_* wrappers and the
-    # metrics pmean
+    # the sanctioned spellings never fire: the bucketed sync dispatcher
+    # and the metrics pmean (the per-leaf wire_* wrappers are wire-raw
+    # clean but fire the inline-grad-sync rule in step scope — see
+    # test_inline_grad_sync_* below)
     ok = (
         "from jax import lax\n"
         "from distributed_pytorch_example_tpu.parallel import wire\n"
-        "def sync(g, m):\n"
-        "    g = wire.wire_psum_scatter(g, 'data', scatter_dimension=0)\n"
-        "    g = wire.wire_psum(g, 'data')\n"
+        "def sync(g, dims, m):\n"
+        "    g = wire.sync_grads(g, dims, 'data')\n"
         "    return g, lax.pmean(m, 'data')\n"
     )
     assert pylint_rules.lint_source("train/step.py", ok) == []
+
+
+@pytest.mark.lint
+def test_inline_grad_sync_fires_on_per_leaf_wire_calls_in_step():
+    # the bucketed comm/compute-overlap schedule owns the gradient-sync
+    # issue order: a per-leaf wire_* call added back to the step is an
+    # inline collective that serializes against the whole backward
+    src = (
+        "from distributed_pytorch_example_tpu.parallel import wire\n"
+        "def body(g):\n"
+        "    return wire.wire_psum_scatter(g, 'data', scatter_dimension=0)\n"
+    )
+    findings = pylint_rules.lint_source("train/step.py", src)
+    assert _rules(findings) == ["inline-grad-sync"]
+    assert "sync_grads" in findings[0].message
+    # bare-name calls and every inline collective spelling fire too
+    for call in ("wire_psum_scatter(g, 'data')",
+                 "wire.wire_all_gather(g, 'data')",
+                 "wire_psum(g, 'data')"):
+        one = f"def body(g):\n    return {call}\n"
+        assert _rules(pylint_rules.lint_source("train/step.py", one)) == [
+            "inline-grad-sync"
+        ], call
+
+
+@pytest.mark.lint
+def test_inline_grad_sync_sanctioned_scope_and_suppression():
+    # sync_grads/replicate_params are the sanctioned entry points
+    ok = (
+        "from distributed_pytorch_example_tpu.parallel import wire\n"
+        "def body(g, dims):\n"
+        "    g = wire.sync_grads(g, dims, 'data')\n"
+        "    return wire.replicate_params(g, None, None)\n"
+    )
+    assert pylint_rules.lint_source("train/step.py", ok) == []
+    # only train/step.py is in scope: the wire module IS the dispatcher
+    bad = "def body(g):\n    return wire_psum_scatter(g, 'data')\n"
+    assert pylint_rules.lint_source("parallel/wire.py", bad) == []
+    assert pylint_rules.lint_source("parallel/api.py", bad) == []
+    supp = bad.replace(
+        "'data')", "'data')  # graft-lint: inline-grad-sync"
+    )
+    assert pylint_rules.lint_source("train/step.py", supp) == []
 
 
 @pytest.mark.lint

@@ -427,3 +427,51 @@ def test_loader_manifest_none_without_sampler():
     assert intake.loader_manifest(Bare(), 0, 0) is None
     with pytest.raises(ValueError, match="no sampler"):
         intake.restore_loader_state(Bare(), {"seed": 0})
+
+
+# ---------------------------------------------------------------------------
+# in-memory decoded-shard cache
+# ---------------------------------------------------------------------------
+
+
+def test_shard_cache_lru_eviction_and_stats():
+    cache = intake.ShardCache(capacity_mb=1)  # 1 MiB
+    kb = 256 * 1024
+    a, b, c = (np.zeros(kb, np.uint8) for _ in range(3))
+    assert cache.put(0, a) and cache.put(1, b) and cache.put(2, c)
+    assert len(cache) == 3 and cache.stats()["resident_bytes"] == 3 * kb
+    cache.get(0)  # refresh 0 — 1 becomes LRU
+    assert cache.put(3, np.zeros(2 * kb, np.uint8))  # evicts 1 (LRU)
+    assert cache.get(1) is None and cache.get(0) is not None
+    st = cache.stats()
+    assert st["evictions"] == 1 and st["entries"] == 3
+    assert st["resident_bytes"] <= st["capacity_bytes"]
+    # an array bigger than the whole cache is refused, never admitted
+    big = np.zeros(2 * 1024 * 1024, np.uint8)
+    assert not cache.admits(big.nbytes) and not cache.put(9, big)
+    # replacement adjusts resident bytes instead of double-counting
+    before = cache.stats()["resident_bytes"]
+    assert cache.put(0, np.zeros(kb // 2, np.uint8))
+    assert cache.stats()["resident_bytes"] == before - kb // 2
+    cache.invalidate(0)
+    assert cache.get(0) is None
+    with pytest.raises(ValueError):
+        intake.ShardCache(capacity_mb=0)
+
+
+def test_shard_cache_serves_identical_rows_and_quarantine_invalidates(
+    tmp_path,
+):
+    root, imgs, labels, nshards = _sealed_shards(tmp_path, "cache")
+    ds = StreamingImageShards(root, max_open_shards=1, cache_mb=64)
+    cold = ds.get_batch(np.arange(0, 128, 8))  # touches all 4 shards
+    warm = ds.get_batch(np.arange(0, 128, 8))  # every row from cache
+    np.testing.assert_array_equal(cold["x"], warm["x"])
+    np.testing.assert_array_equal(cold["y"], warm["y"])
+    st = ds.cache_stats
+    assert st["entries"] == nshards and st["hits"] > 0
+    # quarantine drops the cached copy along with the memmap
+    ds.quarantine([2], reason="test")
+    assert ds.cache_stats["entries"] == nshards - 1
+    # disabled by default: no stats surface, no cache path
+    assert StreamingImageShards(root).cache_stats is None

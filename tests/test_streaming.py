@@ -235,3 +235,61 @@ def test_dequantize_rejects_non_image_uint8(devices):
     # non-uint8 passes through untouched
     tok = jnp.zeros((4, 16), jnp.int32)
     assert dequantize_inputs(tok) is tok
+
+
+def test_shard_cache_eliminates_epoch2_input_stalls(tmp_path, devices):
+    """Two epochs through the real input plane over slow shard IO: epoch
+    1 decodes from disk and stalls the prefetch worker; epoch 2 serves
+    every row from the in-memory ShardCache (cache hits skip the chaos
+    site with the disk), so input_stall_frac collapses to ~0. The memmap
+    pool is pinned far below the shard count so the pool alone cannot
+    explain the drop — the cache-off control stays stalled on epoch 2."""
+    import time
+
+    from distributed_pytorch_example_tpu.data.loader import DeviceLoader
+    from distributed_pytorch_example_tpu.robustness import chaos
+    from distributed_pytorch_example_tpu.runtime import make_mesh
+
+    root = str(tmp_path / "stall")
+    rng = np.random.default_rng(0)
+    write_image_shards(
+        root,
+        [(rng.integers(0, 256, (64, 8, 8, 3)).astype(np.uint8),
+          rng.integers(0, 10, (64,)).astype(np.int64))
+         for _ in range(6)],
+        shard_size=64, seal=True,
+    )
+    mesh = make_mesh()
+
+    def stall_fracs(cache_mb):
+        ds = StreamingImageShards(
+            root, raw_uint8=True, max_open_shards=2, cache_mb=cache_mb
+        )
+        chaos.install(chaos.ChaosPlan(faults=[chaos.Fault(
+            "slow-shard-io", path_substr="images_",
+            count=10_000, delay_s=0.05,
+        )]))
+        try:
+            fracs = []
+            for _epoch in range(2):
+                loader = DeviceLoader(
+                    ds, 32, mesh=mesh, shuffle=False, prefetch=2,
+                    num_shards=1, shard_id=0,
+                )
+                for _ in loader:
+                    time.sleep(0.01)  # a consumer faster than slow IO
+                fracs.append(
+                    loader.stalled_batches / max(loader.batches_served, 1)
+                )
+        finally:
+            chaos.uninstall()
+        return fracs, ds.cache_stats
+
+    fracs, stats = stall_fracs(cache_mb=64)
+    assert fracs[0] > 0.3, fracs  # epoch 1 really stalled on slow disk
+    assert fracs[1] <= 0.15, fracs  # epoch 2 served from RAM
+    assert stats["entries"] == 6 and stats["hits"] > 0
+
+    control, no_stats = stall_fracs(cache_mb=0)
+    assert no_stats is None
+    assert control[1] > 0.3, control  # without the cache epoch 2 stalls

@@ -75,6 +75,7 @@ def build_dataset(args, num_samples: int, seed: int, train: bool = True):
         return StreamingImageShards(
             os.path.join(_data_root(args.data_dir), "image-shards", sub),
             raw_uint8=True,
+            cache_mb=args.shard_cache_mb,
         )
     if name == "tokens-file":
         from distributed_pytorch_example_tpu.data.text import load_token_file
@@ -373,11 +374,20 @@ def main():
     # step, budgets, and telemetry all read one policy object (--auto-mesh
     # plans already lowered their own wire policy)
     if not args.auto_mesh:
+        from distributed_pytorch_example_tpu.parallel.wire import (
+            DEFAULT_BUCKET_BYTES,
+        )
+
+        bucket_bytes = (
+            DEFAULT_BUCKET_BYTES if args.overlap_buckets < 0
+            else args.overlap_buckets
+        )
         partitioner.wire = dpx.parallel.WireConfig(
             compress=args.wire,
             block_size=args.wire_block,
             stochastic_rounding=args.wire_stochastic,
             param_gather=args.wire_param_gather,
+            bucket_bytes=bucket_bytes,
         )
 
     train_loader = dpx.data.DeviceLoader(
