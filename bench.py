@@ -348,6 +348,16 @@ def run_serve(args) -> dict:
             ),
             "completed": fm["completed"],
             "token_exact_vs_single_engine": exact,
+            # graft-swap roll summary (serve.py --publish-dir wires a
+            # live controller; this replay runs none, so the defaults
+            # report a fleet that never swapped)
+            "weights_version": fm.get("weights_version", "v0"),
+            "swaps_completed": fm.get("swaps_completed", 0),
+            "swap_blackout_ms": (
+                round(fm["swap_blackout_ms"], 3)
+                if fm.get("swap_blackout_ms") is not None else None
+            ),
+            "replay_cross_version_exact": fm["replay_cross_version_exact"],
             "steady_per_row_ms": (
                 round(fm["steady_per_row_ms"], 3)
                 if fm["steady_per_row_ms"] is not None else None

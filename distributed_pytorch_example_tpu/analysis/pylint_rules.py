@@ -1,6 +1,6 @@
 """Repo-specific Python AST lints (no jax import, no backend).
 
-Twelve rules, each a distilled past-regression class:
+Thirteen rules, each a distilled past-regression class:
 
 - ``host-sync``: ``.item()`` / ``np.asarray`` / ``jax.device_get`` inside
   TRACED-SCOPE sources (``ops/``, ``models/``, ``parallel/``,
@@ -135,6 +135,18 @@ Twelve rules, each a distilled past-regression class:
   ``paged-decode-fused`` comm-budget signature catches the same
   regression after compile; this rule catches it at the source.
 
+- ``swap-unversioned-params``: an assignment to a ``.params`` /
+  ``.draft_params`` attribute inside ``serving/`` from any function other
+  than ``__init__`` or ``InferenceEngine.install_params``. graft-swap's
+  whole guarantee is that live weights only ever flip through
+  ``install_params``: drained engine, ``weights_version`` retagged, and
+  the partitioner re-placing leaves onto the serve layout — all in one
+  transaction the SwapController brackets with the router's
+  pause/drain/resume roll plane. An ad-hoc ``engine.params = ...``
+  anywhere else swaps weights mid-stream with a stale version tag,
+  silently mixing two versions' logits inside one response — exactly the
+  corruption class the hot-swap-midstream chaos scenario pins.
+
 Scope is static and name-based, not a whole-program call graph — the
 cheap 99% of the check. Deliberate exceptions carry a
 ``# graft-lint: ok`` (all rules) or ``# graft-lint: <rule>`` comment on
@@ -180,6 +192,11 @@ PLAN_OVERLAY_SCOPE = ("parallel/api.py", "train/step.py")
 # dispatch (ops/pallas/paged_attention.py) — the gather fallback itself
 # lives in that module, deliberately OUTSIDE this scope
 DECODE_GATHER_SCOPE = ("serving/", "models/")
+# swap-unversioned-params pins live engine weights to the ONE sanctioned
+# mutation site (InferenceEngine.install_params, plus constructors) —
+# an ad-hoc `.params =` in serving code flips weights without the
+# version retag / drain bracket graft-swap's bit-identity rests on
+SWAP_PARAMS_SCOPE = ("serving/",)
 
 _ACCUM_CTORS = ("zeros", "zeros_like", "full", "empty")
 
@@ -689,6 +706,70 @@ def _decode_gather_findings(
     return [flagged[k] for k in sorted(flagged)]
 
 
+_SWAP_PARAM_ATTRS = ("params", "draft_params")
+_SWAP_SANCTIONED_FUNCS = ("__init__", "install_params")
+
+
+def _swap_unversioned_params_findings(
+    tree: ast.Module, relpath: str, supp: Dict[int, Set[str]]
+) -> List[Finding]:
+    """Live-weight assignments outside the versioned install transaction
+    (module docstring: the graft-swap contract)."""
+    flagged: Dict[int, Finding] = {}  # keyed by line: tuple-target dedup
+
+    def targets_of(node: ast.AST):
+        if isinstance(node, ast.Assign):
+            stack = list(node.targets)
+        elif isinstance(node, ast.AugAssign):
+            stack = [node.target]
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            stack = [node.target]
+        else:
+            return
+        # direct attribute targets and tuple/list unpacking only — an
+        # Attribute buried in a Subscript target (d[obj.params] = x)
+        # does not rebind the live pytree
+        while stack:
+            tgt = stack.pop()
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                stack.extend(tgt.elts)
+            elif isinstance(tgt, ast.Attribute):
+                yield tgt
+
+    def scan(node: ast.AST, func_name: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan(child, child.name)
+                continue
+            for tgt in targets_of(child):
+                if tgt.attr not in _SWAP_PARAM_ATTRS:
+                    continue
+                if func_name in _SWAP_SANCTIONED_FUNCS:
+                    continue
+                if _suppressed(
+                    supp, child.lineno, "swap-unversioned-params"
+                ):
+                    continue
+                flagged.setdefault(child.lineno, Finding(
+                    rule="swap-unversioned-params",
+                    where=f"{relpath}:{child.lineno}",
+                    message=(
+                        f"assignment to .{tgt.attr} outside __init__/"
+                        "install_params: flipping live engine weights "
+                        "here skips the version retag, the partitioner "
+                        "re-placement, and the router's drain bracket — "
+                        "a mid-stream response would mix two versions' "
+                        "logits under a stale weights_version tag; route "
+                        "the swap through InferenceEngine.install_params "
+                        "(graft-swap contract)"
+                    ),
+                ))
+            scan(child, func_name)
+
+    scan(tree, "")
+    return [flagged[k] for k in sorted(flagged)]
+
+
 def lint_source(relpath: str, source: str) -> List[Finding]:
     """All AST findings for one package source file.
 
@@ -889,6 +970,10 @@ def lint_source(relpath: str, source: str) -> List[Finding]:
     if _in_scope(relpath, SERVE_SCOPE):
         findings.extend(_serve_dynamic_shape_findings(tree, relpath, supp))
         findings.extend(_serve_bare_clock_findings(tree, relpath, supp))
+    if _in_scope(relpath, SWAP_PARAMS_SCOPE):
+        findings.extend(
+            _swap_unversioned_params_findings(tree, relpath, supp)
+        )
     if _in_scope(relpath, WAIT_SCOPE):
         findings.extend(_fleet_unbounded_wait_findings(tree, relpath, supp))
     if _in_scope(relpath, INLINE_GRAD_SYNC_SCOPE):

@@ -34,6 +34,9 @@ from distributed_pytorch_example_tpu.robustness.integrity import (  # noqa: F401
     seal,
     unseal,
 )
+from distributed_pytorch_example_tpu.robustness.publish import (  # noqa: F401
+    PublishChannel,
+)
 from distributed_pytorch_example_tpu.robustness.retry import (  # noqa: F401
     with_retries,
 )

@@ -52,7 +52,20 @@ Fault kinds:
   as ``data_stall_ms``, not silently stretch the step time);
 - ``kill-decode-worker`` — crash the supervised prefetch worker at the
   first produced batch index ``>= step`` (fires once; the supervisor
-  must restart it re-producing the exact batch, data/intake.py).
+  must restart it re-producing the exact batch, data/intake.py);
+- ``corrupt-publish`` — bit-flip the ``nth`` published checkpoint
+  artifact AFTER it is fully written but before the pointer flips
+  (graft-swap: the version commits but its CRC is broken, so the fleet's
+  intact-ancestor walk must skip it — robustness/publish.py);
+- ``torn-publish`` — SIGKILL the publisher between the version-dir
+  artifact write and the pointer flip on the ``nth`` publish (the torn
+  window; the fleet must keep serving the previous version and the next
+  publish must heal the channel);
+- ``kill-during-swap`` — abort the SwapController mid-roll at the
+  ``nth`` visit of the named roll stage ``at`` (e.g. ``pre-install``:
+  after the replica drained but before new weights install), simulating
+  a controller crash between replicas; the next tick must resume and
+  complete the roll with the fleet still consistent (serving/swap.py).
 """
 
 from __future__ import annotations
@@ -74,6 +87,7 @@ KINDS = (
     "nan-batch", "inf-batch", "io-error", "kill", "rendezvous-flake",
     "poison-request", "kill-replica", "stall-replica", "flaky-channel",
     "corrupt-shard", "slow-shard-io", "kill-decode-worker",
+    "corrupt-publish", "torn-publish", "kill-during-swap",
 )
 
 
@@ -438,6 +452,62 @@ def decode_worker(batch_index: int) -> None:
             raise RuntimeError(
                 f"chaos: decode worker killed at batch {batch_index}"
             )
+
+
+def publish_fault(stage: str, path: str) -> None:
+    """Publish-channel attack points (robustness/publish.py). Called
+    twice per publish, with the artifact path: stage ``post-artifact``
+    (version fully written, pointer not yet flipped — where
+    ``corrupt-publish`` bit-flips the artifact so the commit carries a
+    broken CRC) and stage ``pre-pointer`` (where ``torn-publish``
+    SIGKILLs the publisher, leaving an uncommitted version dir). Both
+    count matching visits and fire on the ``nth``; ``path_substr``
+    optionally narrows to one channel."""
+    plan = active()
+    if plan is None:
+        return
+    for fault in plan.faults:
+        if fault.path_substr and fault.path_substr not in path:
+            continue
+        if fault.kind == "corrupt-publish" and stage == "post-artifact":
+            fault.fired += 1
+            if fault.fired == fault.nth:
+                logger.warning(
+                    "chaos: corrupting published artifact %s (publish %d)",
+                    path, fault.fired,
+                )
+                corrupt_file(path, mode="bitflip", seed=plan.seed)
+        elif fault.kind == "torn-publish" and stage == "pre-pointer":
+            fault.fired += 1
+            if fault.fired == fault.nth:
+                logger.warning(
+                    "chaos: SIGKILL mid-publish (torn) before pointer "
+                    "flip of %s (publish %d)", path, fault.fired,
+                )
+                os.kill(os.getpid(), signal.SIGKILL)
+
+
+def swap_fault(stage: str) -> bool:
+    """SwapController roll-stage poll (serving/swap.py): a
+    ``kill-during-swap`` fault whose ``at`` matches ``stage`` (empty =
+    any stage) returns True at its ``nth`` matching visit — the
+    controller must abandon the current roll as if it crashed there and
+    finish it on a later tick."""
+    plan = active()
+    if plan is None:
+        return False
+    for fault in plan.faults:
+        if fault.kind == "kill-during-swap" and (
+            not fault.at or fault.at == stage
+        ):
+            fault.fired += 1
+            if fault.fired == fault.nth:
+                logger.warning(
+                    "chaos: aborting swap roll at stage %r (visit %d)",
+                    stage, fault.fired,
+                )
+                return True
+    return False
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,300 @@
+"""graft-swap's publish channel: corruption-safe train→serve handoff.
+
+A :class:`PublishChannel` is a directory a training run publishes sealed,
+mesh-manifest-stamped checkpoint blobs into and a serving fleet polls::
+
+    <root>/
+      versions/
+        00000001/ckpt.msgpack   # CRC-sealed payload (integrity.seal)
+        00000002/ckpt.msgpack
+      LATEST                    # sealed pointer: b"DPX-PUB1\\n" + version
+
+Commit protocol (same discipline as the sharded checkpoint format,
+``train/checkpoint.py``): the version directory and its artifact are
+fully written FIRST, then the ``LATEST`` pointer flips atomically
+(tmp + ``os.replace``). Consequences, by construction:
+
+- a **torn publish** (writer killed between artifact write and pointer
+  flip) is invisible — readers never look past the committed pointer, so
+  the fleet keeps serving the previous version and the next successful
+  publish heals the channel;
+- a **corrupt publish** (bit-flipped artifact) is caught by the CRC
+  envelope at read time and skipped via the graft-armor intact-ancestor
+  walk: :meth:`PublishChannel.latest` falls back to the newest intact
+  version at or below the pointer;
+- a **corrupt pointer** degrades to a committed-version scan (mirroring
+  the sharded checkpoint's garbage-pointer fallback) — but the scan only
+  trusts versions it can verify, so a torn dir still never wins over an
+  intact committed ancestor unless nothing committed survives.
+
+Chaos kinds ``corrupt-publish`` / ``torn-publish`` (robustness/chaos.py)
+attack exactly these two windows; ``scripts/chaos_sweep.py`` and
+``tests/test_step_resume.py`` pin both guarantees.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Callable, List, Optional, Tuple
+
+from distributed_pytorch_example_tpu.robustness import chaos
+from distributed_pytorch_example_tpu.robustness.integrity import (
+    CheckpointCorruptError,
+    is_sealed,
+    seal,
+    unseal,
+)
+from distributed_pytorch_example_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+POINTER_MAGIC = b"DPX-PUB1\n"
+POINTER_NAME = "LATEST"
+VERSIONS_DIR = "versions"
+ARTIFACT_NAME = "ckpt.msgpack"
+DEFAULT_RETAIN = 3
+
+_VERSION_RE = re.compile(r"\d{8}")
+
+
+class PublishChannel:
+    """A versioned publish directory with pointer-flip commit.
+
+    ``retain`` keeps the newest K committed versions (the intact-ancestor
+    walk's fallback depth); older dirs are garbage-collected after each
+    successful pointer flip. The channel is single-writer (the training
+    run) / multi-reader (fleet SwapControllers, the offline doctor).
+    """
+
+    def __init__(self, root: str, *, retain: int = DEFAULT_RETAIN):
+        self.root = str(root)
+        self.retain = max(int(retain), 1)
+        # last (chosen, skipped) the fallback warning fired for: pollers
+        # call latest() several times a second and a degraded-but-
+        # servable channel must not flood the log
+        self._warned_fallback: Optional[tuple] = None
+
+    # -- paths ------------------------------------------------------------
+
+    @property
+    def pointer_path(self) -> str:
+        return os.path.join(self.root, POINTER_NAME)
+
+    @property
+    def versions_root(self) -> str:
+        return os.path.join(self.root, VERSIONS_DIR)
+
+    def artifact_path(self, version: str) -> str:
+        return os.path.join(self.versions_root, version, ARTIFACT_NAME)
+
+    def versions(self) -> List[str]:
+        """All version-dir names on disk, oldest first (committed or not)."""
+        if not os.path.isdir(self.versions_root):
+            return []
+        return sorted(
+            n for n in os.listdir(self.versions_root)
+            if _VERSION_RE.fullmatch(n)
+            and os.path.isdir(os.path.join(self.versions_root, n))
+        )
+
+    # -- writer side ------------------------------------------------------
+
+    def publish_blob(self, blob: bytes) -> str:
+        """Publish one checkpoint blob; returns the committed version name.
+
+        ``blob`` is sealed if it isn't already (checkpoint writers hand
+        over the already-sealed gathered payload, so the common path adds
+        no envelope twice). The pointer flip is the commit point; chaos
+        ``corrupt-publish`` fires after the artifact write and
+        ``torn-publish`` SIGKILLs between artifact and pointer.
+        """
+        if not is_sealed(blob):
+            blob = seal(blob)
+        existing = self.versions()
+        version = f"{(int(existing[-1]) if existing else 0) + 1:08d}"
+        vdir = os.path.join(self.versions_root, version)
+        os.makedirs(vdir, exist_ok=True)
+        artifact = self.artifact_path(version)
+        _atomic_write_bytes(artifact, blob)
+        chaos.publish_fault("post-artifact", artifact)
+        chaos.publish_fault("pre-pointer", artifact)
+        _atomic_write_bytes(
+            self.pointer_path, seal(POINTER_MAGIC + version.encode())
+        )
+        logger.info("publish: committed version %s to %s", version, self.root)
+        self._gc(version)
+        return version
+
+    def _gc(self, pointer_version: str) -> None:
+        """Keep the newest ``retain`` INTACT versions at or below the
+        pointer (the intact-ancestor walk's real fallback depth);
+        everything else at or below it — aged-out ancestors, corrupt
+        commits, torn leftovers from a killed publisher — is removed.
+        This is where a successful publish heals the channel."""
+        keep = set()
+        for name in reversed(self.versions()):
+            if (
+                name <= pointer_version
+                and len(keep) < self.retain
+                and self._intact(name)
+            ):
+                keep.add(name)
+        for name in self.versions():
+            # never remove the pointed version itself, even when corrupt:
+            # the pointer must keep naming an on-disk dir so the doctor
+            # can report WHY the reader walked past it
+            if name not in keep and name < pointer_version:
+                shutil.rmtree(
+                    os.path.join(self.versions_root, name),
+                    ignore_errors=True,
+                )
+
+    # -- reader side ------------------------------------------------------
+
+    def pointer_version(self) -> Optional[str]:
+        """The committed pointer's version name, or None if the pointer is
+        missing/corrupt/malformed (readers then fall back to a scan)."""
+        try:
+            body = _read_sealed(self.pointer_path)
+        except (OSError, CheckpointCorruptError):
+            return None
+        if not body.startswith(POINTER_MAGIC):
+            return None
+        name = body[len(POINTER_MAGIC):].decode("ascii", "replace").strip()
+        return name if _VERSION_RE.fullmatch(name) else None
+
+    def _intact(self, version: str) -> bool:
+        try:
+            _read_sealed(self.artifact_path(version))
+            return True
+        except (OSError, CheckpointCorruptError):
+            return False
+
+    def latest(
+        self, on_event: Optional[Callable[..., None]] = None
+    ) -> Optional[str]:
+        """Newest servable version: the pointed version when intact, else
+        the graft-armor intact-ancestor walk over committed versions
+        (never past the pointer — torn publishes are invisible). A
+        corrupt pointer degrades to the full committed scan. ``on_event``
+        (kind, **fields) mirrors ``load_checkpoint``'s reporting hook.
+        """
+        pointed = self.pointer_version()
+        candidates = [
+            v for v in reversed(self.versions())
+            if pointed is None or v <= pointed
+        ]
+        skipped = []
+        for version in candidates:
+            if self._intact(version):
+                if skipped and on_event is not None:
+                    on_event(
+                        "publish_fallback", chosen=version, skipped=skipped
+                    )
+                if skipped and self._warned_fallback != (version, tuple(skipped)):
+                    self._warned_fallback = (version, tuple(skipped))
+                    logger.warning(
+                        "publish: version(s) %s corrupt; serving intact "
+                        "ancestor %s", skipped, version,
+                    )
+                return version
+            skipped.append(version)
+        return None
+
+    def read(self, version: str) -> bytes:
+        """The verified (unsealed) payload body of ``version``."""
+        return _read_sealed(self.artifact_path(version))
+
+    def load_latest(self) -> Optional[Tuple[str, bytes]]:
+        version = self.latest()
+        if version is None:
+            return None
+        return version, self.read(version)
+
+    # -- offline doctor ---------------------------------------------------
+
+    def state(self) -> dict:
+        """Channel health for ``scripts/reshard_check.py``'s JSON line:
+        pointer integrity, per-version seal/intact status, and the
+        version a fleet would actually serve."""
+        pointed = self.pointer_version()
+        per_version = []
+        for name in self.versions():
+            artifact = self.artifact_path(name)
+            sealed = False
+            try:
+                with open(artifact, "rb") as f:
+                    data = f.read()
+                sealed = is_sealed(data)
+                _read_sealed(artifact)
+                intact = True
+                error = None
+            except (OSError, CheckpointCorruptError) as err:
+                intact = False
+                error = str(err)
+            per_version.append({
+                "version": name,
+                "committed": pointed is not None and name <= pointed,
+                "sealed": sealed,
+                "intact": intact,
+                **({"error": error} if error else {}),
+            })
+        latest = self.latest()
+        return {
+            "root": self.root,
+            "pointer": {
+                "exists": os.path.exists(self.pointer_path),
+                "intact": pointed is not None,
+                "version": pointed,
+            },
+            "versions": per_version,
+            "latest_intact": latest,
+            "ok": latest is not None and latest == pointed,
+        }
+
+
+def is_publish_channel(path: str) -> bool:
+    """Whether ``path`` looks like a channel root (for the doctor's
+    format auto-detect): a ``versions/`` dir or a ``LATEST`` pointer
+    carrying the publish magic."""
+    if os.path.isdir(os.path.join(path, VERSIONS_DIR)):
+        return True
+    pointer = os.path.join(path, POINTER_NAME)
+    try:
+        body = _read_sealed(pointer)
+    except (OSError, CheckpointCorruptError):
+        return False
+    return body.startswith(POINTER_MAGIC)
+
+
+def _read_sealed(path: str) -> bytes:
+    """Verified body of a channel artifact, REQUIRING the CRC envelope.
+
+    ``integrity.unseal`` passes pre-envelope (legacy) files through
+    unverified — right for old checkpoints, wrong here: a bit-flip
+    inside the envelope header would demote a sealed artifact to
+    'legacy' and skip verification. Every channel artifact is written
+    sealed by construction, so an unsealed one IS corruption.
+    """
+    with open(path, "rb") as f:
+        data = f.read()
+    if not is_sealed(data):
+        raise CheckpointCorruptError(
+            f"{path}: publish artifact is not CRC-sealed (torn or "
+            "corrupt envelope)"
+        )
+    return unseal(data, source=path)
+
+
+def _atomic_write_bytes(path: str, blob: bytes) -> None:
+    """tmp + ``os.replace`` (the checkpoint commit discipline); chaos
+    ``io-error`` faults target this via the shared on_write hook."""
+    chaos.on_write(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -36,6 +36,10 @@ from distributed_pytorch_example_tpu.serving.scheduler import (
     RequestState,
     Scheduler,
 )
+from distributed_pytorch_example_tpu.serving.swap import (
+    SwapController,
+    restore_params,
+)
 
 __all__ = [
     "SCRATCH_BLOCK",
@@ -50,7 +54,9 @@ __all__ = [
     "Request",
     "RequestState",
     "Scheduler",
+    "SwapController",
     "fold_keys",
+    "restore_params",
     "sample_rows",
     "truncate_logits",
 ]

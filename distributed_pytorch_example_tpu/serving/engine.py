@@ -311,6 +311,7 @@ class InferenceEngine:
         draft_model=None,
         draft_params=None,
         spec_tokens: int = 0,
+        weights_version: str = "v0",
     ):
         nb = int(getattr(model, "paged_num_blocks", 0))
         bs = int(getattr(model, "paged_block_size", 0))
@@ -346,7 +347,12 @@ class InferenceEngine:
             for a in self._batch_axes:
                 dp *= self._mesh.shape.get(a, 1)
             params = partitioner.shard_tree(params)
+        self._partitioner = partitioner
         self.params = params
+        # graft-swap: every output is tagged with the version of the
+        # weights that produced it; install_params is the ONE sanctioned
+        # place this tag (and the live params) may change after init
+        self.weights_version = str(weights_version)
         # the allocator's shard map must MATCH the pool constraint: the
         # block dim shards over the data axes only when it divides
         self.config = PagedCacheConfig(
@@ -433,6 +439,35 @@ class InferenceEngine:
         self._spec_accepted = 0
 
     # -- plumbing ---------------------------------------------------------
+
+    def install_params(self, params, version, *, draft_params=None) -> None:
+        """Hot-swap the live weights (graft-swap) — the ONE sanctioned
+        live-params assignment outside ``__init__`` (enforced by the
+        ``swap-unversioned-params`` lint, analysis/pylint_rules.py).
+
+        Caller contract (serving/swap.py SwapController): the engine must
+        be DRAINED — idle slots only — when this runs; a mid-stream swap
+        would mix logits from two versions inside one response, which is
+        exactly what the roll plane exists to prevent. ``params`` may be
+        host or device arrays; they are placed onto the engine's serve
+        layout here (``shard_tree`` is a no-op for already-placed leaves).
+        The jitted prefill/decode/verify steps take params as a regular
+        traced argument, so the swap triggers NO recompilation — the next
+        decode boundary simply reads the new pytree.
+
+        ``draft_params`` (speculative decoding) swaps the draft weights
+        in the same transaction; acceptance is exact-match, so serving
+        output is token-identical whether or not the draft swaps — only
+        the accept rate changes.
+        """
+        if self._partitioner is not None:
+            params = self._partitioner.shard_tree(params)
+        self.params = params
+        if draft_params is not None:
+            if self._partitioner is not None:
+                draft_params = self._partitioner.shard_tree(draft_params)
+            self.draft_params = draft_params
+        self.weights_version = str(version)
 
     def _mesh_ctx(self):
         import contextlib

@@ -89,6 +89,12 @@ class ReplicaHandle:
         self._error = ""
         self._last_beat = self.clock()
         self._inflight: Dict[str, List[int]] = {}
+        # rids the worker popped from the inbox but that have not yet
+        # appeared in the scheduler's active set — without this the
+        # swap controller's drained check (resident==0, inbox==0) has a
+        # torn-read window mid-admission and a weight install could
+        # split one stream across two versions
+        self._admitting: set = set()
         self._free_slots = engine.config.num_slots
         self._free_blocks = engine.config.num_blocks - 1  # minus scratch
         self._prev_decode_t: Optional[float] = None
@@ -146,7 +152,9 @@ class ReplicaHandle:
                 "free_slots": self._free_slots,
                 "free_blocks": self._free_blocks,
                 "inbox_depth": self._inbox.qsize(),
-                "resident": len(self._inflight),
+                # admitting rids count as resident: the worker owns them
+                # even though the scheduler hasn't seated them yet
+                "resident": len(self._inflight) + len(self._admitting),
                 "inflight": {
                     rid: list(toks) for rid, toks in self._inflight.items()
                 },
@@ -230,10 +238,14 @@ class ReplicaHandle:
             self._last_beat = self.clock()
         try:
             if timeout <= 0:
-                return self._inbox.get_nowait()
-            return self._inbox.get(timeout=timeout)
+                req = self._inbox.get_nowait()
+            else:
+                req = self._inbox.get(timeout=timeout)
         except queue.Empty:
             return None
+        with self._lock:
+            self._admitting.add(req.rid)
+        return req
 
     def _should_stop(self) -> bool:
         return self._drain.is_set() and self._inbox.qsize() == 0
@@ -242,6 +254,7 @@ class ReplicaHandle:
         now = self.clock()
         with self._lock:
             self._inflight.pop(st.request.rid, None)
+            self._admitting.discard(st.request.rid)
             self.finished += 1
         if self.on_finish is not None:
             self.on_finish({
@@ -249,6 +262,10 @@ class ReplicaHandle:
                 "rid": st.request.rid,
                 "status": st.status,
                 "tokens": list(st.generated),
+                # graft-swap: the weights version that produced this
+                # output (read at completion — a drained replica never
+                # swaps mid-stream, so this is the whole stream's version)
+                "weights_version": self.engine.weights_version,
                 "error": st.error,
                 "prompt_len": st.prompt_len,
                 "preemptions": st.preemptions,
@@ -266,6 +283,7 @@ class ReplicaHandle:
                 st.request.rid: list(st.generated)
                 for _slot, st in sched.active()
             }
+            self._admitting.difference_update(self._inflight)
             self._free_slots = sched.free_slots()
             self._free_blocks = sched.allocator.free_count()
             if rows:

@@ -67,6 +67,11 @@ class JournalEntry:
     dispatches: int = 0
     replays: int = 0  # redispatches that had already emitted tokens
     replay_token_exact: Optional[bool] = None
+    # graft-swap version trail: the weights version live on the replica
+    # at FIRST dispatch, and the version that produced the final output —
+    # they differ exactly when a journal replay crossed a hot-swap
+    first_version: str = ""
+    weights_version: str = ""
     t_submit: float = 0.0
     t_dispatch: float = 0.0
     t_done: float = 0.0
@@ -114,6 +119,11 @@ class FleetRouter:
         self._completions: "queue.Queue[dict]" = queue.Queue()
         self._affinity: Dict[str, str] = {}  # session -> replica_id
         self._lost: Dict[str, float] = {}  # replica_id -> detection latency
+        # graft-swap roll plane: paused replicas take no NEW placements
+        # (affine requests wait; others route around) but stay healthy —
+        # pause is how the SwapController drains one replica at a time
+        self._paused: set = set()
+        self._replay_cross_version: List[bool] = []
         self._t_first_loss: Optional[float] = None
         self.counters: Dict[str, int] = {
             "shed": 0, "redispatched": 0, "replayed": 0,
@@ -127,6 +137,19 @@ class FleetRouter:
         self._last_queue_depth = -1
         self._ticks = 0
         self._next_observe = 0.0
+
+    # -- graft-swap roll plane ---------------------------------------------
+
+    def pause_replica(self, replica_id: str) -> None:
+        """Stop placing NEW requests on a replica (SwapController drain
+        step). Residents keep decoding; session-affine requests for it
+        queue rather than rehome, keeping co-resident streams on one
+        weights version. Health checks still apply — a paused replica
+        that dies fails over normally."""
+        self._paused.add(str(replica_id))
+
+    def resume_replica(self, replica_id: str) -> None:
+        self._paused.discard(str(replica_id))
 
     # -- placement ---------------------------------------------------------
 
@@ -160,12 +183,16 @@ class FleetRouter:
                 )
                 if handle is None:
                     del self._affinity[session]  # rehome: replica lost
+                elif handle.replica_id in self._paused:
+                    return None  # sticky but swapping: wait (stay affine)
                 elif self._admissible(handle, handle.snapshot(), entry.request):
                     return handle
                 else:
                     return None  # sticky but full: wait (stay affine)
         best, best_key = None, None
         for handle in live:
+            if handle.replica_id in self._paused:
+                continue
             snap = handle.snapshot()
             if not self._admissible(handle, snap, entry.request):
                 continue
@@ -194,6 +221,8 @@ class FleetRouter:
         entry.replica = handle.replica_id
         entry.dispatches += 1
         entry.t_dispatch = now
+        if entry.dispatches == 1:
+            entry.first_version = handle.engine.weights_version
         if entry.dispatches == 1:
             self.latency.add("queue_wait_ms", (now - entry.t_submit) * 1e3)
         if req.session is not None:
@@ -234,6 +263,7 @@ class FleetRouter:
             self._lost[rep] = now - beat
             if self._t_first_loss is None:
                 self._t_first_loss = now
+            self._paused.discard(rep)  # a lost replica is past pausing
             handle.abort()
             _undispatched, inflight = handle.drain_outstanding()
             self._affinity = {
@@ -312,10 +342,23 @@ class FleetRouter:
                     "journal_lag_ms",
                     max(self.clock() - res["t_done"], 0.0) * 1e3,
                 )
+            entry.weights_version = res.get("weights_version", "")
             if entry.replays and entry.status == "done":
                 entry.replay_token_exact = (
                     res["tokens"][: len(entry.tokens)] == entry.tokens
                 )
+                if (
+                    entry.weights_version
+                    and entry.first_version
+                    and entry.weights_version != entry.first_version
+                ):
+                    # the journal replay completed under a DIFFERENT
+                    # weights version than its first dispatch — the
+                    # hot-swap crossing the position-folded rng must
+                    # keep token-exact anyway
+                    self._replay_cross_version.append(
+                        entry.replay_token_exact
+                    )
 
     # -- graft-lens instrumentation ----------------------------------------
 
@@ -352,9 +395,14 @@ class FleetRouter:
     # -- the routing loop --------------------------------------------------
 
     def run(self, requests: Sequence[Request], *,
-            timeout_s: float = 600.0) -> dict:
+            timeout_s: float = 600.0, swap=None) -> dict:
         """Route an open-loop workload to completion across the fleet;
-        returns per-request results plus router/fleet metrics."""
+        returns per-request results plus router/fleet metrics.
+
+        ``swap`` (graft-swap): a ``serving.swap.SwapController`` ticked
+        once per loop iteration from this thread; the run ends only when
+        the workload is done AND no staged version is mid-roll, so a
+        completed pass always reports a fully-adopted fleet."""
         for handle in self.replicas:
             handle.on_finish = self._completions.put
             if handle.state() == "new":
@@ -410,6 +458,8 @@ class FleetRouter:
                 # be replayed because its replica died a tick later
                 self._drain_completions(journal)
                 self._check_health(journal, order, rqueue, now)
+                if swap is not None:
+                    swap.tick(self, now)
 
                 # deadline shedding, oldest first
                 while rqueue and (
@@ -426,14 +476,23 @@ class FleetRouter:
                         break  # head-of-line, like Scheduler.admit
                     self._dispatch(rqueue.popleft(), handle, now)
 
-                if next_arrival >= len(pending) and all(
-                    journal[rid].status in _TERMINAL for rid in order
+                if (
+                    next_arrival >= len(pending)
+                    and all(
+                        journal[rid].status in _TERMINAL for rid in order
+                    )
+                    and (swap is None or not swap.pending())
                 ):
                     break
                 if not self._live() and rqueue:
                     stuck = [e.request.rid for e in rqueue]
+                    states = {
+                        h.replica_id: f"{h.state()}:{h.error() or '-'}"
+                        for h in self.replicas
+                    }
                     raise RuntimeError(
-                        f"all replicas lost with requests queued: {stuck}"
+                        f"all replicas lost with requests queued: {stuck} "
+                        f"(replicas: {states})"
                     )
                 self.sleep(0.002)
         finally:
@@ -445,7 +504,10 @@ class FleetRouter:
                     handle.abort()
 
         elapsed = max(self.clock() - t_start, 1e-9)
-        return self._report(journal, order, elapsed)
+        report = self._report(journal, order, elapsed)
+        if swap is not None:
+            report["metrics"].update(swap.metrics())
+        return report
 
     # -- reporting ---------------------------------------------------------
 
@@ -467,6 +529,7 @@ class FleetRouter:
                 "dispatches": entry.dispatches,
                 "replays": entry.replays,
                 "replay_token_exact": entry.replay_token_exact,
+                "weights_version": entry.weights_version,
                 "preemptions": res.get("preemptions", 0),
             }
             status_counts[entry.status] = (
@@ -509,6 +572,10 @@ class FleetRouter:
             ),
             "replay_token_exact": (
                 all(replay_checks) if replay_checks else None
+            ),
+            "replay_cross_version_exact": (
+                all(self._replay_cross_version)
+                if self._replay_cross_version else None
             ),
             "queue_depth_max": self._queue_depth_max,
             "elapsed_s": elapsed,

@@ -1,0 +1,318 @@
+"""graft-swap: zero-downtime train→serve weight hot-swap.
+
+The :class:`SwapController` is the fleet-side half of the publish channel
+(``robustness/publish.py``): it polls the channel from the router's
+single control thread, stages each new intact version ONCE (verify →
+mesh-manifest validate → reshard onto the serve layout, streaming per
+leaf), then rolls replicas one at a time through the router's
+drain/redispatch plane:
+
+1. **pause** — the router stops placing new work on the replica
+   (session-affine requests for it WAIT rather than rehome, so
+   co-resident streams never migrate mid-swap);
+2. **drain** — residents finish on the OLD weights: a swap must never
+   mix two versions' logits inside one response stream;
+3. **install** — once idle, :meth:`InferenceEngine.install_params` flips
+   the live pytree and the ``weights_version`` tag (a pointer swap; the
+   jitted steps take params as a traced argument, so no recompile);
+4. **resume** — the router readmits the replica. The measured
+   idle→readmitted window is the ``swap_blackout_ms`` the serve JSON
+   line gates against one decode-boundary p99.
+
+A replica lost MID-roll is the router's problem, not ours: its requests
+replay from the dispatch journal onto whichever replica (and therefore
+whichever version) picks them up — position-folded rng keeps the
+replayed stream token-exact either way, and the router reports those
+under ``replay_cross_version_exact``. Chaos ``kill-during-swap``
+(robustness/chaos.py) aborts the controller mid-roll instead; the next
+tick resumes and completes the same staged version.
+
+Staging failures are corrupt-publish survivals, not errors: a version
+whose payload fails CRC/restore is marked failed and the channel's
+intact-ancestor walk (``PublishChannel.latest``) has already hidden it
+from the next poll — a corrupt or torn publish never reaches a replica.
+
+Transports: ``exact`` device_puts the restored host leaves verbatim
+(bit-exact with the training checkpoint — what the hot-swap-midstream
+bit-identity gate uses); ``int8`` pushes each float leaf through the
+graft-wire block quantizer (``parallel/wire.quantize_blocks``) first —
+the EQuARX-style lossy param channel, ~4x less host→device traffic, for
+deployments where the swap link is the bottleneck.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import serialization
+
+from distributed_pytorch_example_tpu.parallel.wire import (
+    dequantize_blocks,
+    quantize_blocks,
+)
+from distributed_pytorch_example_tpu.robustness import chaos, elastic
+from distributed_pytorch_example_tpu.robustness.publish import PublishChannel
+from distributed_pytorch_example_tpu.runtime.logging import get_logger
+
+__all__ = ["SwapController", "restore_params"]
+
+logger = get_logger(__name__)
+
+TRANSPORTS = ("exact", "int8")
+_INT8_BLOCK = 256
+
+
+def restore_params(
+    body: bytes,
+    template,
+    *,
+    source: str = "<publish-channel>",
+    transport: str = "exact",
+) -> tuple:
+    """(resharded params, payload meta) from a published payload body.
+
+    Mirrors the gathered checkpoint restore
+    (``train/checkpoint._load_gathered_file``): msgpack-restore the
+    (already CRC-verified) payload, validate its graft-elastic mesh
+    manifest against the SERVE layout's axes, ``from_state_dict`` onto
+    the engine's params template, then stream leaf-by-leaf onto the
+    template's shardings — per-leaf device_put bounds host memory to one
+    leaf beyond the payload, the same discipline as the sharded loader.
+    """
+    if transport not in TRANSPORTS:
+        raise ValueError(
+            f"unknown swap transport {transport!r} (one of {TRANSPORTS})"
+        )
+    payload = serialization.msgpack_restore(body)
+    if not isinstance(payload, dict) or "state" not in payload:
+        raise ValueError(f"{source}: not a published checkpoint payload")
+    state_dict = payload["state"]
+    # published payloads carry a full train state; engines hold params
+    params_dict = state_dict.get("params", state_dict)
+    target_axes = elastic.tree_mesh_axes(template)
+    elastic.validate_resume(
+        payload.get(elastic.MANIFEST_KEY), target_axes, source
+    )
+    restored = serialization.from_state_dict(template, params_dict)
+
+    def place(path, tmpl, val):
+        arr = jnp.asarray(val)
+        # geometry guard: from_state_dict does NOT shape-check plain
+        # arrays, and install_params is a pointer swap — a payload from
+        # the wrong model geometry would pass staging and then kill
+        # every replica at its next decode (ScopeParamShapeError).
+        # Failing here turns it into an unstageable-version quarantine:
+        # the fleet keeps serving its current weights.
+        tshape = getattr(tmpl, "shape", None)
+        if tshape is not None and tuple(arr.shape) != tuple(tshape):
+            raise ValueError(
+                f"{source}: published leaf "
+                f"{jax.tree_util.keystr(path)} has shape "
+                f"{tuple(arr.shape)} but the serve template expects "
+                f"{tuple(tshape)} — wrong model geometry for this fleet"
+            )
+        if transport == "int8" and jnp.issubdtype(arr.dtype, jnp.floating):
+            # graft-wire int8-block param channel: ship (values s8,
+            # scales bf16) across the host->device link and expand on
+            # device — lossy (one amax scale per block), so the exact
+            # transport is the one bit-identity gates run against
+            q, scales = quantize_blocks(arr, _INT8_BLOCK)
+            val = dequantize_blocks(q, scales, arr.shape, arr.dtype)
+        sharding = getattr(tmpl, "sharding", None)
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            return jax.device_put(val, sharding)
+        # unsharded template: return an UNCOMMITTED array like the one
+        # the engine compiled against — a committed device_put here
+        # changes the jit cache key and the first post-install decode
+        # recompiles mid-serve-loop, freezing heartbeats past the
+        # router's deadline
+        return jnp.asarray(val)
+
+    params = jax.tree_util.tree_map_with_path(place, template, restored)
+    meta = {
+        "epoch": payload.get("epoch"),
+        "loss": payload.get("loss"),
+        "extra": payload.get("extra", {}),
+    }
+    return params, meta
+
+
+class SwapController:
+    """Rolls published weight versions through a live fleet, one replica
+    at a time, from the router's control thread (``tick`` is called once
+    per routing-loop iteration — single-threaded by construction, so no
+    state here needs a lock).
+
+    ``min_decode_steps`` holds the roll of each replica until it has
+    passed that many decode boundaries — the hot-swap-midstream chaos
+    scenario uses it to force the swap to land provably mid-stream.
+    """
+
+    def __init__(
+        self,
+        channel: PublishChannel,
+        handles: Sequence,
+        *,
+        poll_s: float = 0.25,
+        transport: str = "exact",
+        min_decode_steps: int = 0,
+        initial_version: Optional[str] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if transport not in TRANSPORTS:
+            raise ValueError(
+                f"unknown swap transport {transport!r} (one of {TRANSPORTS})"
+            )
+        self.channel = channel
+        self.handles = list(handles)
+        self.poll_s = float(poll_s)
+        self.transport = transport
+        self.min_decode_steps = int(min_decode_steps)
+        self.clock = clock
+        # the version the fleet currently serves; adopting a published
+        # version only happens through a completed roll
+        self.current_version = (
+            initial_version
+            if initial_version is not None
+            else self.handles[0].engine.weights_version
+        )
+        self.swaps_completed = 0
+        self.swap_aborts = 0
+        self.blackouts_ms: List[float] = []
+        self._staged = None  # (version, params) resharded onto serve layout
+        self._roll_queue: List[str] = []
+        self._rolling: Optional[str] = None
+        self._failed: set = set()
+        self._next_poll = 0.0
+
+    # -- channel side ------------------------------------------------------
+
+    def _poll(self, now: float) -> None:
+        if now < self._next_poll:
+            return
+        self._next_poll = now + self.poll_s
+        version = self.channel.latest()
+        if (
+            version is None
+            or version == self.current_version
+            or version in self._failed
+        ):
+            return
+        try:
+            body = self.channel.read(version)
+            params, meta = restore_params(
+                body,
+                self.handles[0].engine.params,
+                source=self.channel.artifact_path(version),
+                transport=self.transport,
+            )
+        except Exception as err:  # noqa: BLE001 — a bad version must
+            # never take the fleet down; it is skipped like a corrupt
+            # checkpoint ancestor
+            self._failed.add(version)
+            logger.warning(
+                "swap: staging version %s failed (%s: %s); fleet stays "
+                "on %s", version, type(err).__name__, err,
+                self.current_version,
+            )
+            return
+        self._staged = (version, params)
+        self._roll_queue = [h.replica_id for h in self.handles]
+        self._rolling = None
+        logger.info(
+            "swap: staged version %s (epoch %s) — rolling %d replica(s)",
+            version, meta.get("epoch"), len(self._roll_queue),
+        )
+
+    # -- roll plane --------------------------------------------------------
+
+    def _handle(self, replica_id: str):
+        return next(
+            h for h in self.handles if h.replica_id == replica_id
+        )
+
+    def tick(self, router, now: Optional[float] = None) -> None:
+        """One controller step; call from every routing-loop iteration."""
+        now = self.clock() if now is None else now
+        if self._staged is None:
+            self._poll(now)
+            if self._staged is None:
+                return
+        version, params = self._staged
+        if self._rolling is None:
+            while self._roll_queue:
+                rid = self._roll_queue[0]
+                handle = self._handle(rid)
+                if handle.state() != "live" or not handle.alive():
+                    # lost/retired mid-roll: nothing serves old weights
+                    # there anymore; its journal entries replay onto
+                    # already-swapped replicas (cross-version replay)
+                    self._roll_queue.pop(0)
+                    continue
+                if handle.decode_steps < self.min_decode_steps:
+                    return  # not provably mid-stream yet; try next tick
+                router.pause_replica(rid)
+                self._rolling = rid
+                return  # residents drain on old weights
+            # every replica rolled: the fleet has adopted the version
+            self.current_version = version
+            self._staged = None
+            self.swaps_completed += 1
+            logger.info("swap: fleet adopted version %s", version)
+            return
+        rid = self._rolling
+        handle = self._handle(rid)
+        if handle.state() != "live" or not handle.alive():
+            # died while draining — the router's health plane owns it now
+            router.resume_replica(rid)
+            self._rolling = None
+            self._roll_queue.pop(0)
+            return
+        snap = handle.snapshot()
+        if snap["resident"] or snap["inbox_depth"]:
+            return  # still finishing residents on the old version
+        if chaos.swap_fault("pre-install"):
+            # controller 'crashed' between drain and install: release the
+            # replica un-swapped; the staged version stays pending and a
+            # later tick re-drains and completes the same roll
+            router.resume_replica(rid)
+            self._rolling = None
+            self.swap_aborts += 1
+            return
+        t_idle = self.clock()
+        engine = handle.engine
+        # a self-drafting engine (draft shares the target weights) swaps
+        # both in one transaction; a distinct draft model keeps its own —
+        # exact-match acceptance keeps output token-identical either way
+        draft = params if engine.draft_params is engine.params else None
+        engine.install_params(params, version, draft_params=draft)
+        router.resume_replica(rid)
+        blackout_ms = (self.clock() - t_idle) * 1e3
+        self.blackouts_ms.append(blackout_ms)
+        self._rolling = None
+        self._roll_queue.pop(0)
+        logger.info(
+            "swap: replica %s -> version %s (blackout %.3f ms)",
+            rid, version, blackout_ms,
+        )
+
+    def pending(self) -> bool:
+        """Whether a staged version has not finished rolling — the
+        router's run() holds the fleet open until this clears."""
+        return self._staged is not None
+
+    # -- reporting ---------------------------------------------------------
+
+    def metrics(self) -> dict:
+        return {
+            "weights_version": self.current_version,
+            "swaps_completed": self.swaps_completed,
+            "swap_aborts": self.swap_aborts,
+            "swap_rolls": len(self.blackouts_ms),
+            "swap_blackout_ms": (
+                max(self.blackouts_ms) if self.blackouts_ms else None
+            ),
+        }

@@ -242,10 +242,13 @@ def _gathered_history_paths(path: str) -> List[str]:
     return [os.path.join(hist_dir, n) for n in names]
 
 
-def _write_payload(
-    path: str, host_state, epoch: int, loss: float, extra,
-    retain: int = DEFAULT_RETAIN, mesh_manifest: Optional[dict] = None,
-) -> None:
+def _payload_blob(
+    host_state, epoch: int, loss: float, extra,
+    mesh_manifest: Optional[dict] = None,
+) -> bytes:
+    """Sealed gathered-payload blob — shared by the latest/best file
+    write and the graft-swap publish channel, so a published version is
+    byte-compatible with a gathered checkpoint restore."""
     payload = {
         "epoch": epoch,
         "loss": float(loss),
@@ -256,7 +259,14 @@ def _write_payload(
         # format-3 mesh stamp (graft-elastic): what topology this state
         # was sharded under at save time — validate_resume reads it back
         payload[elastic.MANIFEST_KEY] = mesh_manifest
-    blob = seal(serialization.msgpack_serialize(payload))
+    return seal(serialization.msgpack_serialize(payload))
+
+
+def _write_payload(
+    path: str, host_state, epoch: int, loss: float, extra,
+    retain: int = DEFAULT_RETAIN, mesh_manifest: Optional[dict] = None,
+) -> None:
+    blob = _payload_blob(host_state, epoch, loss, extra, mesh_manifest)
     if retain > 0:
         # retention trail: the sealed blob lands in {path}.history/ first,
         # then `path` is committed as a hard link (copy on filesystems
@@ -707,6 +717,48 @@ def _order_fallback_candidates(
     return same_mesh + other
 
 
+def publish_checkpoint(
+    channel,
+    state: Any,
+    epoch: int,
+    loss: float,
+    extra: Optional[dict] = None,
+    saver: Optional[AsyncSaver] = None,
+) -> Optional[str]:
+    """Publish the train state to a graft-swap ``PublishChannel``.
+
+    The published artifact is the SAME sealed, mesh-manifest-stamped
+    gathered payload ``save_checkpoint`` writes (``_payload_blob``), so a
+    serving fleet's SwapController restores it through the ordinary
+    gathered path — ``elastic.validate_resume`` + per-leaf reshard onto
+    the serve layout (serving/swap.py).
+
+    Collective rules mirror the gathered save: the host gather is a
+    collective, so EVERY process must enter; only process 0 writes the
+    channel. With ``saver`` (process_count == 1 only — same constraint
+    as the async gathered save) the fetch+serialize+publish runs on the
+    AsyncSaver thread and None is returned; otherwise the committed
+    version name is returned on process 0.
+    """
+    stamp = elastic.mesh_manifest(state)
+    if saver is not None and jax.process_count() == 1:
+        snap = jax.tree_util.tree_map(
+            lambda x: x.copy() if isinstance(x, jax.Array) else x, state
+        )
+        saver.submit(
+            lambda: channel.publish_blob(
+                _payload_blob(_gather_to_host(snap), epoch, loss, extra, stamp)
+            )
+        )
+        return None
+    host_state = _gather_to_host(state)
+    if jax.process_index() != 0:
+        return None
+    return channel.publish_blob(
+        _payload_blob(host_state, epoch, loss, extra, stamp)
+    )
+
+
 def save_checkpoint(
     path: str,
     state: Any,
@@ -716,6 +768,7 @@ def save_checkpoint(
     saver: Optional[AsyncSaver] = None,
     sharded: bool = False,
     retain: int = DEFAULT_RETAIN,
+    publish=None,
 ) -> None:
     """Write a checkpoint; see module docstring for the two formats.
 
@@ -725,6 +778,13 @@ def save_checkpoint(
     ``retain`` keeps the newest K generations restorable (fallback
     ancestors for ``load_checkpoint``); 1 reproduces the pre-r10
     only-the-live-checkpoint behavior.
+
+    ``publish`` (graft-swap): also publish the gathered payload to the
+    given ``PublishChannel``. On the gathered paths this reuses the
+    already-gathered host state (async: inside the same background job);
+    on the sharded paths it runs ``publish_checkpoint`` on the MAIN
+    thread first, because the publish gather is a collective the
+    background shard writer must never issue.
     """
     version = _version(epoch, (extra or {}).get("batch_in_epoch"))
     # format-3 mesh stamp (graft-elastic): derived from the live state's
@@ -732,18 +792,25 @@ def save_checkpoint(
     # shardings, but stamping here keeps the manifest identical for the
     # sync and async paths
     stamp = elastic.mesh_manifest(state)
+
+    def gathered_write(snap):
+        host_state = _gather_to_host(snap)
+        _write_payload(
+            path, host_state, epoch, loss, extra, retain=retain,
+            mesh_manifest=stamp,
+        )
+        if publish is not None:
+            publish.publish_blob(
+                _payload_blob(host_state, epoch, loss, extra, stamp)
+            )
+
     write = (
         (lambda snap: _save_sharded(
             path, snap, epoch, loss, extra, retain=retain, version=version,
             mesh_manifest=stamp,
         ))
         if sharded
-        else (
-            lambda snap: _write_payload(
-                path, _gather_to_host(snap), epoch, loss, extra,
-                retain=retain, mesh_manifest=stamp,
-            )
-        )
+        else gathered_write
     )
     if sharded:
         # a still-draining PREVIOUS async write may target the same
@@ -753,6 +820,8 @@ def save_checkpoint(
         if saver is not None:
             saver.wait()
         _begin_sharded_save(path, version)  # main thread: cleanup + barrier
+        if publish is not None:
+            publish_checkpoint(publish, state, epoch, loss, extra=extra)
     if saver is not None and (sharded or jax.process_count() == 1):
         # HBM-side copy: later donated train steps cannot invalidate it
         snap = jax.tree_util.tree_map(
@@ -773,6 +842,10 @@ def save_checkpoint(
         path, host_state, epoch, loss, extra, retain=retain,
         mesh_manifest=stamp,
     )
+    if publish is not None:
+        publish.publish_blob(
+            _payload_blob(host_state, epoch, loss, extra, stamp)
+        )
 
 
 def load_checkpoint(

@@ -112,6 +112,7 @@ class Trainer:
         max_bad_steps: int = 8,
         skip_nonfinite: bool = True,
         checkpoint_retain: int = ckpt_lib.DEFAULT_RETAIN,
+        publish_dir: Optional[str] = None,
         wire=None,
     ):
         self.model = model
@@ -142,6 +143,18 @@ class Trainer:
         # keep-last-K checkpoint generations (fallback ancestors for
         # corrupt-latest auto-recovery, train/checkpoint.py)
         self.checkpoint_retain = checkpoint_retain
+        # graft-swap: every checkpoint also lands in this PublishChannel
+        # (corruption-safe pointer-flip commit) for live fleet hot-swap;
+        # construction is side-effect-free and publish_checkpoint itself
+        # restricts the write to process 0, so every process may hold one
+        if publish_dir:
+            from distributed_pytorch_example_tpu.robustness.publish import (
+                PublishChannel,
+            )
+
+            self._publish_channel = PublishChannel(publish_dir)
+        else:
+            self._publish_channel = None
         # graft-wire collective compression (parallel/wire.py): explicit
         # arg wins, else the partitioner's, else fp32 payloads
         from distributed_pytorch_example_tpu.parallel.wire import WireConfig
@@ -628,6 +641,7 @@ class Trainer:
                 saver=self._saver,
                 sharded=self._sharded_ckpt(),
                 retain=self.checkpoint_retain,
+                publish=self._publish_channel,
             )
 
     def validate(self, loader) -> Dict[str, float]:
@@ -941,6 +955,9 @@ class Trainer:
                             sharded=self._sharded_ckpt(),
                             retain=self.checkpoint_retain,
                         )
+                    # publish rides the LATEST save only — best would
+                    # double-publish the same params and roll the fleet
+                    # twice in one epoch
                     ckpt_lib.save_checkpoint(
                         os.path.join(
                             self.checkpoint_dir, ckpt_lib.LATEST_NAME
@@ -952,6 +969,7 @@ class Trainer:
                         saver=self._saver,
                         sharded=self._sharded_ckpt(),
                         retain=self.checkpoint_retain,
+                        publish=self._publish_channel,
                     )
             dist.barrier("epoch-end")
         return history, self._best_accuracy

@@ -218,6 +218,11 @@ def add_framework_args(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
                         help="intact checkpoint generations kept per root "
                         "(keep-last-K; older ones are fallback candidates "
                         "when `latest` is torn or corrupt)")
+    parser.add_argument("--publish-dir", type=str, default=None,
+                        help="graft-swap: also publish every checkpoint to "
+                        "this PublishChannel directory; a serving fleet "
+                        "started with the same --publish-dir hot-swaps "
+                        "onto each committed version with zero downtime")
     parser.add_argument("--chaos", type=str, default=None,
                         help="deterministic fault injection: a preset name "
                         "(nan-step|io-flake) or a ChaosPlan JSON object; "

@@ -69,6 +69,16 @@ matrix runs under ``-m slow``):
                         exact batch the training loop expects next, so
                         losses and final params are bit-identical to an
                         uninjected run, with the restart in telemetry.
+- ``hot-swap-midstream`` * Live weight sync (graft-swap): a fine-tuned
+                        checkpoint is published and rolled through a
+                        two-replica fleet mid-decode. In-flight streams
+                        finish bit-identical to an unswapped control
+                        (greedy AND seeded top-k), post-swap sessions
+                        carry the new ``weights_version`` and match a
+                        reference on the fine-tuned params, the swap
+                        blackout stays under one decode-boundary p99,
+                        and a corrupt commit + torn publish in the same
+                        channel never reach a replica.
 
 Usage:
   python scripts/chaos_sweep.py [--fast] [--scenarios a,b,...]
@@ -91,7 +101,7 @@ if REPO_ROOT not in sys.path:
 FAST = (
     "nan-skip", "corrupt-latest", "io-flake", "rendezvous-flake",
     "kill-slice", "poison-request", "kill-replica-midstream",
-    "corrupt-shard-midepoch", "kill-decode-worker",
+    "corrupt-shard-midepoch", "kill-decode-worker", "hot-swap-midstream",
 )
 SLOW = (
     "inf-skip", "budget-rollback", "truncate-shard", "torn-save-kill",
@@ -914,6 +924,242 @@ def scenario_kill_decode_worker() -> dict:
     }
 
 
+def scenario_hot_swap_midstream() -> dict:
+    """Live weight hot-swap mid-decode (graft-swap): fine-tune a few
+    steps, publish through the corruption-safe channel, and roll the new
+    version through a two-replica fleet WHILE it decodes. In-flight
+    streams must finish bit-identical to an unswapped control — greedy
+    AND seeded top-k — because a replica drains before install, so no
+    stream ever mixes two versions' logits; post-swap sessions must
+    carry the published ``weights_version`` and match a reference fleet
+    running the fine-tuned params; the measured ``swap_blackout_ms``
+    must stay under one decode-boundary p99; and a corrupt commit plus a
+    torn (uncommitted) publish sitting in the SAME channel must never
+    reach a replica."""
+    import hashlib
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.data.synthetic import _ArrayDataset
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+    from distributed_pytorch_example_tpu.robustness import chaos
+    from distributed_pytorch_example_tpu.robustness.publish import (
+        PublishChannel,
+    )
+    from distributed_pytorch_example_tpu.serving import (
+        FleetRouter, InferenceEngine, Request, ReplicaHandle,
+        SwapController,
+    )
+    from distributed_pytorch_example_tpu.train import checkpoint as ckpt_lib
+
+    kw = dict(vocab_size=61, max_len=32, model_dim=16, num_layers=1,
+              num_heads=2, mlp_dim=32)
+    v0_params = GPT2(**kw).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    model = GPT2(**kw, decode=True, paged_num_blocks=16,
+                 paged_block_size=4, paged_max_blocks=4)
+
+    # fine-tune K=4 optimizer steps on the fake mesh: the version the
+    # fleet must adopt (stamped with the dp8 mesh manifest, which the
+    # swap restore validates against the serve layout)
+    mesh = dpx.runtime.make_mesh()
+    trainer = dpx.train.Trainer(
+        GPT2(**kw), dpx.train.CausalLMTask(), optax.adam(1e-2),
+        partitioner=dpx.parallel.data_parallel(mesh), log_every=1,
+    )
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(0, 61, (128, 16)).astype(np.int32)
+    loader = dpx.data.DeviceLoader(
+        _ArrayDataset({"tokens": tokens}), 32, mesh=mesh, seed=0
+    )
+    history = trainer.fit(loader, epochs=1)
+    tuned = jax.tree_util.tree_map(np.asarray, trainer.state.params)
+
+    def digest(params):
+        h = hashlib.sha256()
+        for leaf in jax.tree_util.tree_leaves(params):
+            h.update(np.asarray(leaf).tobytes())
+        return h.hexdigest()
+
+    rng_req = np.random.default_rng(7)
+
+    def make_requests(prefix, n, seed0):
+        return [
+            Request(rid=f"{prefix}{i:02d}",
+                    prompt=[int(t)
+                            for t in rng_req.integers(0, 61, 4 + i % 5)],
+                    max_new_tokens=8, seed=seed0 + i)
+            for i in range(n)
+        ]
+
+    requests_a = make_requests("a", 12, 1000)  # in flight during the roll
+    requests_b = make_requests("b", 6, 2000)   # post-swap new sessions
+
+    with tempfile.TemporaryDirectory() as td:
+        channel = PublishChannel(os.path.join(td, "publish"))
+        good = ckpt_lib.publish_checkpoint(
+            channel, trainer.state, epoch=1,
+            loss=float(history[-1]["train_loss"]),
+        )
+        # a LATER corrupt commit: the pointer names it, so adopting it
+        # would be the pointer-chasing bug — the intact-ancestor walk
+        # must fall back to `good`
+        chaos.install(chaos.ChaosPlan(faults=[
+            chaos.Fault("corrupt-publish", nth=1)
+        ]))
+        try:
+            ckpt_lib.publish_checkpoint(
+                channel, trainer.state, epoch=1, loss=0.0
+            )
+        finally:
+            chaos.uninstall()
+        corrupt = channel.pointer_version()
+        # a torn publish: artifact on disk, pointer never flipped —
+        # readers must not even consider it (it is past the pointer)
+        torn = f"{int(corrupt) + 1:08d}"
+        os.makedirs(os.path.join(channel.versions_root, torn))
+        with open(channel.artifact_path(torn), "wb") as f:
+            f.write(b"\x00" * 64)
+        chan_state = channel.state()
+
+        def fleet_run(requests, temperature, top_k, *, engines=None,
+                      params=v0_params, version="v0", swap=False):
+            engines = engines or [
+                InferenceEngine(model, params, num_slots=3,
+                                temperature=temperature, top_k=top_k,
+                                weights_version=version)
+                for _ in range(2)
+            ]
+            handles = [
+                ReplicaHandle(f"r{i}", e) for i, e in enumerate(engines)
+            ]
+            router = FleetRouter(handles, heartbeat_timeout_s=2.0)
+            ctrl = SwapController(
+                channel, handles, poll_s=0.05, min_decode_steps=2,
+            ) if swap else None
+            report = router.run(requests, timeout_s=120.0, swap=ctrl)
+            return report, engines, handles, ctrl
+
+        detail = {
+            "published_good": good,
+            "published_corrupt": corrupt,
+            "torn_dir": torn,
+            "channel_latest": chan_state["latest_intact"],
+            "tuned_params_differ": digest(tuned) != digest(v0_params),
+        }
+        ok = (
+            chan_state["latest_intact"] == good
+            and not next(
+                v for v in chan_state["versions"]
+                if v["version"] == corrupt
+            )["intact"]
+            and not next(
+                v for v in chan_state["versions"] if v["version"] == torn
+            )["committed"]
+            and detail["tuned_params_differ"]
+        )
+        for regime, temperature, top_k in (
+            ("greedy", 0.0, None), ("seeded-topk", 0.9, 5),
+        ):
+            # XLA compile freezes replica heartbeats: warm this sampling
+            # regime's programs before any router with a 2s deadline
+            InferenceEngine(model, v0_params, num_slots=3,
+                            temperature=temperature, top_k=top_k).warmup()
+            control, _e, ch, _c = fleet_run(requests_a, temperature, top_k)
+            reference, _e2, _h2, _c2 = fleet_run(
+                requests_a + requests_b, temperature, top_k,
+                params=tuned, version=good,
+            )
+            swapped, engines, _h3, ctrl = fleet_run(
+                requests_a, temperature, top_k, swap=True,
+            )
+            sm = swapped["metrics"]
+            res = swapped["results"]
+            versions_seen = {r["weights_version"] for r in res.values()}
+            old_streams = [
+                rid for rid, r in res.items()
+                if r["weights_version"] == "v0"
+            ]
+            # streams that finished on the OLD weights (in flight while
+            # the fleet rolled) must be bit-identical to the unswapped
+            # control; streams admitted AFTER their replica swapped must
+            # match the fine-tuned reference
+            co_identical = all(
+                res[rid]["status"] == "done"
+                and control["results"][rid]["status"] == "done"
+                and res[rid]["tokens"] == control["results"][rid]["tokens"]
+                for rid in old_streams
+            )
+            new_match = all(
+                res[rid]["status"] == "done"
+                and res[rid]["tokens"]
+                == reference["results"][rid]["tokens"]
+                for rid, r in res.items()
+                if r["weights_version"] == good
+            )
+            # pass B: fresh sessions on the SAME (now swapped) engines —
+            # every one must carry the published version's tag and the
+            # fine-tuned params' tokens
+            handles_b = [
+                ReplicaHandle(f"r{i}", e) for i, e in enumerate(engines)
+            ]
+            fresh = FleetRouter(handles_b, heartbeat_timeout_s=2.0).run(
+                requests_b, timeout_s=120.0
+            )
+            fresh_on_new = all(
+                r["status"] == "done"
+                and r["weights_version"] == good
+                and r["tokens"] == reference["results"][rid]["tokens"]
+                for rid, r in fresh["results"].items()
+            )
+            # blackout gate: the pause→install→readmit window must cost
+            # less than one decode boundary (p99 over the control run's
+            # full-occupancy boundary costs; 5 ms floor absorbs host
+            # timer jitter on a loaded box — the install is a pointer
+            # swap, orders of magnitude under either bound)
+            boundary_ms = sorted(
+                s_per_row * 3 * 1e3
+                for h in ch for (_t, s_per_row) in h.step_samples()
+            )
+            p99_ms = (
+                boundary_ms[int(0.99 * (len(boundary_ms) - 1))]
+                if boundary_ms else None
+            )
+            blackout = sm.get("swap_blackout_ms")
+            blackout_ok = (
+                blackout is not None
+                and blackout <= max(p99_ms or 0.0, 5.0)
+            )
+            regime_ok = (
+                ctrl.current_version == good
+                and sm["weights_version"] == good
+                and sm["swaps_completed"] == 1
+                and versions_seen <= {"v0", good}
+                and len(old_streams) >= 1
+                and co_identical and new_match and fresh_on_new
+                and blackout_ok
+            )
+            detail[regime] = {
+                "swaps_completed": sm["swaps_completed"],
+                "swap_rolls": sm["swap_rolls"],
+                "swap_blackout_ms": blackout,
+                "decode_boundary_p99_ms": p99_ms,
+                "versions_seen": sorted(versions_seen),
+                "old_version_streams": len(old_streams),
+                "co_resident_bit_identical": co_identical,
+                "post_swap_match_reference": new_match,
+                "fresh_sessions_on_new_version": fresh_on_new,
+            }
+            ok = ok and regime_ok
+    return {"ok": ok, "action": "drain-install-readmit", **detail}
+
+
 SCENARIOS = {
     "nan-skip": lambda: scenario_poison_skip("nan-batch"),
     "inf-skip": lambda: scenario_poison_skip("inf-batch"),
@@ -929,6 +1175,7 @@ SCENARIOS = {
     "kill-replica-midstream": scenario_kill_replica_midstream,
     "corrupt-shard-midepoch": scenario_corrupt_shard_midepoch,
     "kill-decode-worker": scenario_kill_decode_worker,
+    "hot-swap-midstream": scenario_hot_swap_midstream,
 }
 assert set(SCENARIOS) == set(ALL)
 

@@ -6,8 +6,10 @@
 # wire-raw-collective rule pinning train/step.py's gradient sync to the
 # parallel/wire.py dispatch, the plan-overlay rule pinning
 # parallel/api.py + train/step.py shardings to the PlanSpec lowering,
-# and the decode-gather rule pinning serving//models/ paged-pool access
-# to the fused paged_decode_attention dispatch) plus the
+# the decode-gather rule pinning serving//models/ paged-pool access
+# to the fused paged_decode_attention dispatch, and the
+# swap-unversioned-params rule pinning live serving weights to the
+# InferenceEngine.install_params transaction) plus the
 # paged-decode-fused budget-signature units and the backend-free
 # graft-plan planner units, without initializing a JAX backend, so it
 # is safe on any box — laptop, CI, or the TPU host.

@@ -15,13 +15,21 @@ touching devices, and prints ONE JSON line:
   sharded axis keeps its size), ``replicate`` (unsharded leaf),
   ``repartition-zero1`` (ZeRO-1 opt-state leaf scattered over a resized
   ``data`` axis), ``rebalance-pipe`` (leaf stacked over a resized
-  ``pipe`` axis), or ``reshard`` (any other re-slice).
+  ``pipe`` axis), or ``reshard`` (any other re-slice);
+- a graft-swap publish channel (``robustness/publish.py``) is
+  auto-detected and reported as format ``publish-channel``: the
+  ``channel`` block is ``PublishChannel.state()`` verbatim (pointer
+  integrity, per-version seal/intact status, the version a fleet would
+  actually serve), and the manifest/loader/target checks run against
+  that servable version's payload.
 
 Usage:
-  python scripts/reshard_check.py <ckpt> [--target data=4,tensor=2]
+  python scripts/reshard_check.py <ckpt-or-channel> [--target data=4,...]
 
-Exit code 0 iff every artifact is intact (and, with ``--target``, the
-checkpoint is resumable onto it).
+Exit code 0 iff every artifact is intact (for a publish channel: the
+pointed version itself is servable — a degraded channel limping on an
+intact ancestor exits 1) and, with ``--target``, the checkpoint is
+resumable onto it.
 """
 
 from __future__ import annotations
@@ -45,6 +53,7 @@ from flax import serialization  # noqa: E402
 
 from distributed_pytorch_example_tpu.data import intake  # noqa: E402
 from distributed_pytorch_example_tpu.robustness import elastic  # noqa: E402
+from distributed_pytorch_example_tpu.robustness import publish  # noqa: E402
 from distributed_pytorch_example_tpu.robustness.integrity import (  # noqa: E402
     is_sealed,
     unseal,
@@ -123,7 +132,35 @@ def inspect_checkpoint(path: str, target: dict | None) -> dict:
 
     stamp = None
     version = None
-    if ckpt_lib._is_sharded(path):
+    channel_ok = None
+    if publish.is_publish_channel(path):
+        report["format"] = "publish-channel"
+        channel = publish.PublishChannel(path)
+        state = channel.state()
+        report["channel"] = state
+        version = state["latest_intact"]
+        artifacts = [
+            {
+                "name": f"{v['version']}/{publish.ARTIFACT_NAME}",
+                "sealed": v["sealed"], "intact": v["intact"],
+                **({"error": v["error"]} if v.get("error") else {}),
+                "body": None,
+            }
+            for v in state["versions"]
+        ]
+        blob = None
+        if version is not None:
+            try:
+                blob = serialization.msgpack_restore(channel.read(version))
+            except Exception as err:  # CRC-intact but not a checkpoint
+                report["error"] = (
+                    f"version {version} payload is not msgpack: {err}"
+                )
+        # channel health is the POINTED version being servable — a fleet
+        # limping on an intact ancestor (corrupt head) is degraded even
+        # though every remaining artifact verifies
+        channel_ok = bool(state["ok"])
+    elif ckpt_lib._is_sharded(path):
         report["format"] = "sharded"
         step_dir = ckpt_lib._pointed_version_dir(path)
         if step_dir is None or not os.path.isdir(step_dir):
@@ -154,7 +191,10 @@ def inspect_checkpoint(path: str, target: dict | None) -> dict:
     report["artifacts"] = [
         {k: v for k, v in a.items() if k != "body"} for a in artifacts
     ]
-    intact = all(a["intact"] for a in artifacts) and blob is not None
+    intact = (
+        channel_ok if channel_ok is not None
+        else all(a["intact"] for a in artifacts)
+    ) and blob is not None
     if isinstance(blob, dict):
         raw_stamp = blob.get(elastic.MANIFEST_KEY)
         stamp = raw_stamp if isinstance(raw_stamp, dict) else None

@@ -220,8 +220,11 @@ def run_fleet(args, trace, built, requests):
     ``steady_per_row_ms`` that ``steady_state_ratio`` divides by.
     """
     from distributed_pytorch_example_tpu.robustness import chaos
+    from distributed_pytorch_example_tpu.robustness.publish import (
+        PublishChannel,
+    )
     from distributed_pytorch_example_tpu.serving import (
-        FleetRouter, ReplicaHandle,
+        FleetRouter, ReplicaHandle, SwapController,
     )
     from distributed_pytorch_example_tpu.telemetry import ServeSentinels
 
@@ -242,9 +245,22 @@ def run_fleet(args, trace, built, requests):
             trace=trace,
             sentinels=sentinels,
         )
+        ctrl = None
+        if args.publish_dir:
+            # graft-swap: the router ticks the controller once per loop
+            # iteration; any version committed into the channel while
+            # the workload runs rolls through drain/install/readmit
+            ctrl = SwapController(
+                PublishChannel(args.publish_dir),
+                handles,
+                poll_s=(
+                    args.swap_poll_s
+                    if args.swap_poll_s is not None else 0.25
+                ),
+            )
         print(f"serve: fleet pass '{tag}' ({args.replicas} replicas)",
               file=sys.stderr)
-        report = router.run(requests)
+        report = router.run(requests, swap=ctrl)
         # fleet decode throughput: each worker thread runs serve_loop
         # exactly once per pass, so per-engine counters cover the pass;
         # rates pool by summed counts (not averaged per-replica ratios)
@@ -320,6 +336,12 @@ def _config_dict(args):
         **({"sessions": args.sessions} if args.sessions else {}),
         **({"replicas": args.replicas} if args.replicas > 1 else {}),
         **({"spec_tokens": args.spec_tokens} if args.spec_tokens else {}),
+        **({
+            "publish_dir": args.publish_dir,
+            "swap_poll_s": (
+                args.swap_poll_s if args.swap_poll_s is not None else 0.25
+            ),
+        } if getattr(args, "publish_dir", "") else {}),
     }
 
 
@@ -388,6 +410,15 @@ def emit_fleet_line(args, report, baseline) -> int:
             if m["detection_latency_s"] is not None else None
         ),
         "replay_token_exact": m["replay_token_exact"],
+        # graft-swap roll summary: defaults (no controller) report a
+        # fleet that never swapped — version v0, zero swaps, no blackout
+        "weights_version": m.get("weights_version", "v0"),
+        "swaps_completed": m.get("swaps_completed", 0),
+        "swap_blackout_ms": (
+            round(m["swap_blackout_ms"], 3)
+            if m.get("swap_blackout_ms") is not None else None
+        ),
+        "replay_cross_version_exact": m["replay_cross_version_exact"],
         "queue_depth_max": m["queue_depth_max"],
         # graft-lens rolling latency summaries (ms over the run's window)
         "ttft_p99_ms": _round(m["ttft_p99_ms"], 3),
@@ -513,6 +544,15 @@ def main() -> int:
     parser.add_argument("--chaos", default="",
                         help="fault-injection preset name or JSON plan "
                         "(same contract as train.py; e.g. kill-replica)")
+    parser.add_argument("--publish-dir", default="", metavar="DIR",
+                        help="graft-swap: poll this publish channel "
+                        "(robustness/publish.py) and hot-swap newly "
+                        "committed weight versions through the fleet's "
+                        "drain/install/readmit roll plane (fleet mode "
+                        "only: needs --replicas >= 2)")
+    parser.add_argument("--swap-poll-s", type=float, default=None,
+                        help="graft-swap: publish-channel poll interval "
+                        "in seconds (default 0.25; needs --publish-dir)")
     parser.add_argument("--heartbeat-timeout", type=float, default=5.0,
                         help="fleet: seconds without a replica heartbeat "
                         "before the router declares it lost")
@@ -531,6 +571,14 @@ def main() -> int:
         parser.error("--spec-tokens must be 0 (off) or >= 2")
     if args.auto_mesh and args.mesh:
         parser.error("--auto-mesh replaces --mesh; drop one")
+    if args.swap_poll_s is not None and not args.publish_dir:
+        parser.error("--swap-poll-s needs --publish-dir; add the channel "
+                     "or drop the interval")
+    if args.publish_dir and args.replicas < 2:
+        parser.error("--publish-dir (graft-swap) rolls through the fleet "
+                     "router; use --replicas >= 2")
+    if args.swap_poll_s is not None and args.swap_poll_s <= 0:
+        parser.error("--swap-poll-s must be > 0")
 
     from distributed_pytorch_example_tpu.telemetry.trace import TraceWriter
 

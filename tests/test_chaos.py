@@ -543,6 +543,7 @@ def test_chaos_sweep_fast_subset_green():
         "nan-skip", "corrupt-latest", "io-flake", "rendezvous-flake",
         "kill-slice", "poison-request", "kill-replica-midstream",
         "corrupt-shard-midepoch", "kill-decode-worker",
+        "hot-swap-midstream",
     ]
     assert all(r["ok"] for r in lines), lines
     by_name = {r["scenario"]: r for r in lines}
@@ -568,6 +569,14 @@ def test_chaos_sweep_fast_subset_green():
     assert decode["worker_restarts"] >= 1
     assert decode["max_loss_diff_vs_uninjected"] == 0.0
     assert decode["params_match_uninjected"] is True
+    swap = by_name["hot-swap-midstream"]
+    assert swap["action"] == "drain-install-readmit"
+    assert swap["channel_latest"] == swap["published_good"]
+    for regime in ("greedy", "seeded-topk"):
+        assert swap[regime]["swaps_completed"] == 1
+        assert swap[regime]["co_resident_bit_identical"] is True
+        assert swap[regime]["fresh_sessions_on_new_version"] is True
+        assert swap[regime]["swap_blackout_ms"] is not None
 
 
 @pytest.mark.slow

@@ -540,6 +540,78 @@ def test_fleet_unbounded_wait_covers_data_scope():
 
 
 @pytest.mark.lint
+def test_swap_unversioned_params_fires_on_adhoc_assignments():
+    # flipping live engine weights anywhere but __init__/install_params
+    # skips the version retag + drain bracket (graft-swap contract)
+    src = (
+        "class Engine:\n"
+        "    def refresh(self, new):\n"
+        "        self.params = new\n"
+        "        self.draft_params, other = new, 1\n"
+        "        self.params += 0\n"
+        "def hotfix(handle, new):\n"
+        "    handle.engine.params = new\n"
+    )
+    findings = pylint_rules.lint_source("serving/swap.py", src)
+    assert _rules(findings) == ["swap-unversioned-params"] * 4
+    assert "swap.py:3" in findings[0].where
+    assert "install_params" in findings[0].message
+
+
+@pytest.mark.lint
+def test_swap_unversioned_params_sanctioned_and_lookalikes_quiet():
+    # __init__ and install_params are THE sanctioned mutation sites; a
+    # subscript keyed by .params reads, not rebinds, the live pytree
+    src = (
+        "class Engine:\n"
+        "    def __init__(self, params):\n"
+        "        self.params = params\n"
+        "        self.draft_params = None\n"
+        "    def install_params(self, params, version):\n"
+        "        self.params = params\n"
+        "        self.draft_params = params\n"
+        "    def lookup(self, cache, new):\n"
+        "        cache[self.params] = new\n"
+        "        hyper = new.params\n"
+        "        return hyper\n"
+    )
+    assert pylint_rules.lint_source("serving/engine.py", src) == []
+
+
+@pytest.mark.lint
+def test_swap_unversioned_params_scope_and_suppression():
+    src = (
+        "def adopt(trainer, new):\n"
+        "    trainer.state.params = new\n"
+    )
+    # out of scope: the trainer rebinds its own state params freely
+    assert pylint_rules.lint_source("train/loop.py", src) == []
+    supp = src.replace(
+        "= new", "= new  # graft-lint: swap-unversioned-params"
+    )
+    assert pylint_rules.lint_source("serving/swap.py", supp) == []
+
+
+@pytest.mark.lint
+def test_swap_real_serving_modules_clean():
+    # the acceptance gate: every shipped serving module mutates live
+    # params only through __init__/install_params
+    serving_dir = os.path.join(
+        REPO_ROOT, "distributed_pytorch_example_tpu", "serving"
+    )
+    for fname in sorted(os.listdir(serving_dir)):
+        if not fname.endswith(".py"):
+            continue
+        with open(os.path.join(serving_dir, fname)) as f:
+            src = f.read()
+        findings = [
+            fi for fi in pylint_rules.lint_source(f"serving/{fname}", src)
+            if fi.rule == "swap-unversioned-params"
+        ]
+        assert findings == [], [fi.render() for fi in findings]
+
+
+@pytest.mark.lint
 def test_wire_raw_collective_fires_in_step_scope():
     # a raw gradient collective in the step bypasses the WireConfig
     # dispatch — fp32 payloads regardless of --wire int8-block

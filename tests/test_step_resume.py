@@ -188,6 +188,64 @@ def test_torn_sharded_save_resumes_previous_intact_checkpoint(tmp_path):
     assert (int(m.group(1)), int(m.group(2))) == (0, 2)
 
 
+def test_torn_publish_sigkill_keeps_pointer_and_heals(tmp_path):
+    """SIGKILL between the publish-channel artifact write and the LATEST
+    pointer flip (graft-swap's torn window, robustness/publish.py): the
+    torn version must stay invisible to readers — the pointer still
+    names v1, so a polling fleet keeps serving it — and the next
+    successful publish flips the pointer past the leftover, restoring
+    the channel to fully healthy."""
+    from distributed_pytorch_example_tpu.robustness.publish import (
+        PublishChannel,
+    )
+
+    root = str(tmp_path / "chan")
+    child = (
+        "import sys\n"
+        "from distributed_pytorch_example_tpu.robustness.publish import (\n"
+        "    PublishChannel,\n"
+        ")\n"
+        "ch = PublishChannel(sys.argv[1])\n"
+        "ch.publish_blob(b'payload-v1')\n"
+        "ch.publish_blob(b'payload-v2')  # SIGKILLed before pointer flip\n"
+        "print('UNREACHABLE')\n"
+    )
+    env = _env()
+    env["DPX_CHAOS"] = json.dumps(
+        {"faults": [{"kind": "torn-publish", "nth": 2}]}
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", child, root],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300,
+    )
+    assert proc.returncode == -signal.SIGKILL, (
+        proc.returncode, proc.stderr[-2000:]
+    )
+    assert "UNREACHABLE" not in proc.stdout
+
+    # the torn version's artifact landed on disk, but the commit point
+    # (the pointer flip) never happened: readers cannot see it
+    ch = PublishChannel(root)
+    assert ch.versions() == ["00000001", "00000002"]
+    assert os.path.exists(ch.artifact_path("00000002"))
+    assert ch.pointer_version() == "00000001"
+    assert ch.latest() == "00000001"
+    assert ch.read("00000001") == b"payload-v1"
+    state = ch.state()
+    # torn-but-uncommitted leftovers do not even degrade the channel
+    assert state["ok"] is True
+    assert state["latest_intact"] == "00000001"
+    assert [v["committed"] for v in state["versions"]] == [True, False]
+
+    # the next publish numbers PAST the leftover and flips the pointer:
+    # the channel is healthy again with no operator intervention
+    healed = ch.publish_blob(b"payload-v3")
+    assert healed == "00000003"
+    assert ch.pointer_version() == "00000003"
+    assert ch.latest() == "00000003"
+    assert ch.state()["ok"] is True
+
+
 def test_iter_from_matches_tail_of_full_iteration(devices):
     """loader.iter_from(k) yields exactly the batches a full iteration
     yields from step k on (the cursor contract resume relies on)."""

@@ -1,0 +1,463 @@
+"""graft-swap: the publish channel's commit/corruption guarantees, the
+restore transport, and the SwapController's drain-install-readmit roll
+plane.
+
+The channel and controller units run against fake handles/routers (no
+engine compile, tier-1 cheap); the real-engine token-exactness e2e is
+``slow`` (the hot-swap-midstream chaos scenario covers the full fleet
+path in tier-1 via ``tests/test_chaos.py``). SIGKILL-shaped torn-publish
+coverage lives in ``tests/test_step_resume.py`` (subprocess child).
+"""
+
+import os
+
+import numpy as np
+import pytest
+from flax import serialization
+
+from distributed_pytorch_example_tpu.robustness import chaos
+from distributed_pytorch_example_tpu.robustness.chaos import corrupt_file
+from distributed_pytorch_example_tpu.robustness.integrity import (
+    CheckpointCorruptError,
+)
+from distributed_pytorch_example_tpu.robustness.publish import (
+    PublishChannel,
+    is_publish_channel,
+)
+from distributed_pytorch_example_tpu.serving.swap import (
+    SwapController,
+    restore_params,
+)
+
+# ---------------------------------------------------------------------------
+# publish channel
+# ---------------------------------------------------------------------------
+
+
+def test_channel_publish_read_roundtrip(tmp_path):
+    ch = PublishChannel(str(tmp_path / "chan"))
+    assert ch.latest() is None and ch.load_latest() is None
+    v1 = ch.publish_blob(b"alpha")
+    v2 = ch.publish_blob(b"beta")
+    assert (v1, v2) == ("00000001", "00000002")
+    assert ch.pointer_version() == v2
+    assert ch.latest() == v2
+    assert ch.read(v1) == b"alpha"
+    assert ch.load_latest() == (v2, b"beta")
+    assert is_publish_channel(ch.root)
+    assert not is_publish_channel(str(tmp_path))
+
+
+def test_channel_retention_gc_keeps_newest_intact(tmp_path):
+    ch = PublishChannel(str(tmp_path / "chan"), retain=2)
+    for i in range(4):
+        ch.publish_blob(f"payload-{i}".encode())
+    # newest `retain` committed versions survive; older dirs are gone
+    assert ch.versions() == ["00000003", "00000004"]
+    assert ch.latest() == "00000004"
+
+
+def test_channel_corrupt_head_falls_back_then_heals(tmp_path):
+    ch = PublishChannel(str(tmp_path / "chan"))
+    good = ch.publish_blob(b"good")
+    bad = ch.publish_blob(b"soon-corrupt")
+    corrupt_file(ch.artifact_path(bad), mode="bitflip", seed=0)
+    # the pointer names the corrupt head; the intact-ancestor walk must
+    # serve the committed ancestor instead — and a direct read of the
+    # corrupt version must raise, never hand back garbage
+    assert ch.pointer_version() == bad
+    assert ch.latest() == good
+    with pytest.raises(CheckpointCorruptError):
+        ch.read(bad)
+    state = ch.state()
+    assert state["ok"] is False
+    assert state["latest_intact"] == good
+    # GC spares the pointed version even when corrupt (the doctor must
+    # be able to say WHY readers walked past it) ...
+    assert bad in ch.versions()
+    # ... and the next successful publish removes it: healed
+    healed = ch.publish_blob(b"fixed")
+    assert ch.latest() == healed
+    assert bad not in ch.versions()
+    assert ch.state()["ok"] is True
+
+
+def test_channel_corrupt_pointer_degrades_to_scan(tmp_path):
+    ch = PublishChannel(str(tmp_path / "chan"))
+    v1 = ch.publish_blob(b"one")
+    ch.publish_blob(b"two")
+    corrupt_file(ch.artifact_path("00000002"), mode="truncate")
+    corrupt_file(ch.pointer_path, mode="bitflip", seed=1)
+    assert ch.pointer_version() is None
+    # the scan only trusts versions it can verify
+    assert ch.latest() == v1
+    state = ch.state()
+    assert state["pointer"]["exists"] and not state["pointer"]["intact"]
+    assert state["ok"] is False
+
+
+def test_chaos_corrupt_publish_fires_on_nth(tmp_path):
+    ch = PublishChannel(str(tmp_path / "chan"))
+    chaos.install(chaos.ChaosPlan(
+        [chaos.Fault("corrupt-publish", nth=2)]
+    ))
+    try:
+        v1 = ch.publish_blob(b"first")
+        v2 = ch.publish_blob(b"second")
+    finally:
+        chaos.uninstall()
+    assert ch.pointer_version() == v2
+    assert ch.latest() == v1  # the nth=2 commit carries a broken CRC
+    assert ch.read(v1) == b"first"
+
+
+# ---------------------------------------------------------------------------
+# restore transport
+# ---------------------------------------------------------------------------
+
+
+def _params_tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "dense": {"kernel": rng.normal(size=(4, 8)).astype(np.float32)},
+        "embed": rng.normal(size=(16, 4)).astype(np.float32),
+        "steps": np.arange(6, dtype=np.int32),
+    }
+
+
+def _payload_body(params, **extra):
+    return serialization.msgpack_serialize({
+        "state": {"params": serialization.to_state_dict(params)},
+        "epoch": 1, "loss": 0.25, "extra": dict(extra),
+    })
+
+
+def test_restore_params_exact_roundtrip():
+    import jax
+
+    published = _params_tree(seed=1)
+    template = jax.tree_util.tree_map(np.zeros_like, published)
+    params, meta = restore_params(
+        _payload_body(published), template, transport="exact"
+    )
+    for got, want in zip(
+        jax.tree_util.tree_leaves(params),
+        jax.tree_util.tree_leaves(published),
+    ):
+        np.testing.assert_array_equal(np.asarray(got), want)
+    assert meta["epoch"] == 1 and meta["loss"] == 0.25
+
+
+def test_restore_params_int8_transport_is_lossy_but_close():
+    import jax
+
+    published = _params_tree(seed=2)
+    template = jax.tree_util.tree_map(np.zeros_like, published)
+    params, _ = restore_params(
+        _payload_body(published), template, transport="int8"
+    )
+    # float leaves pass through the int8-block quantizer: close, and (at
+    # this scale) NOT bit-exact — the lossiness is why the bit-identity
+    # gates pin the exact transport
+    kernel = np.asarray(params["dense"]["kernel"])
+    want = published["dense"]["kernel"]
+    np.testing.assert_allclose(kernel, want, atol=0.02)
+    assert not np.array_equal(kernel, want)
+    # integer leaves (step counters etc.) ship verbatim
+    np.testing.assert_array_equal(
+        np.asarray(params["steps"]), published["steps"]
+    )
+
+
+def test_restore_params_rejects_garbage_and_unknown_transport():
+    template = {"w": np.zeros((2,), np.float32)}
+    with pytest.raises(ValueError, match="not a published checkpoint"):
+        restore_params(
+            serialization.msgpack_serialize({"nope": 1}), template
+        )
+    with pytest.raises(ValueError, match="unknown swap transport"):
+        restore_params(b"", template, transport="fp8")
+
+
+def test_restore_params_rejects_wrong_geometry():
+    # a structurally-matching payload from the WRONG model geometry must
+    # fail at restore (→ unstageable-version quarantine), naming the
+    # leaf — install_params is a pointer swap, so without this guard the
+    # bad shape only surfaces as a dead replica at the next decode
+    params = _params_tree(seed=0)
+    wrong = {
+        "dense": {"kernel": np.zeros((4, 16), np.float32)},  # 8 → 16
+        "embed": params["embed"],
+        "steps": params["steps"],
+    }
+    with pytest.raises(ValueError, match=r"kernel.*\(4, 16\).*\(4, 8\)"):
+        restore_params(_payload_body(wrong), params)
+
+
+# ---------------------------------------------------------------------------
+# SwapController roll plane (fake handles/router: no engine compile)
+# ---------------------------------------------------------------------------
+
+
+class _FakeEngine:
+    def __init__(self, params):
+        self.params = params
+        self.draft_params = None
+        self.weights_version = "v0"
+        self.installs = []
+
+    def install_params(self, params, version, *, draft_params=None):
+        self.params = params
+        self.weights_version = str(version)
+        self.installs.append(str(version))
+
+
+class _FakeHandle:
+    def __init__(self, rid, params):
+        self.replica_id = rid
+        self.engine = _FakeEngine(params)
+        self.decode_steps = 100
+        self.resident = 0
+
+    def state(self):
+        return "live"
+
+    def alive(self):
+        return True
+
+    def snapshot(self):
+        return {"resident": self.resident, "inbox_depth": 0}
+
+
+class _FakeRouter:
+    def __init__(self):
+        self.paused = []
+        self.resumed = []
+
+    def pause_replica(self, rid):
+        self.paused.append(rid)
+
+    def resume_replica(self, rid):
+        self.resumed.append(rid)
+
+
+def _controller(tmp_path, n=2, **kw):
+    template = _params_tree(seed=0)
+    ch = PublishChannel(str(tmp_path / "chan"))
+    handles = [_FakeHandle(f"r{i}", template) for i in range(n)]
+    ctrl = SwapController(ch, handles, poll_s=0.0, **kw)
+    return ch, handles, ctrl
+
+
+def _tick_until_adopted(ctrl, router, start=0.0, limit=32):
+    t = start
+    staged = False
+    for _ in range(limit):
+        ctrl.tick(router, now=t)
+        t += 1.0
+        staged = staged or ctrl.pending()
+        if staged and not ctrl.pending():
+            return
+    raise AssertionError("controller never staged+finished a roll")
+
+
+def test_swap_controller_rolls_each_replica_once(tmp_path):
+    ch, handles, ctrl = _controller(tmp_path)
+    router = _FakeRouter()
+    ctrl.tick(router, now=0.0)  # empty channel: nothing to do
+    assert not ctrl.pending() and ctrl.current_version == "v0"
+
+    version = ch.publish_blob(_payload_body(_params_tree(seed=3)))
+    _tick_until_adopted(ctrl, router)
+    assert ctrl.current_version == version
+    assert ctrl.swaps_completed == 1
+    # one drain bracket per replica, in order
+    assert router.paused == ["r0", "r1"]
+    assert router.resumed == ["r0", "r1"]
+    for h in handles:
+        assert h.engine.installs == [version]
+        assert h.engine.weights_version == version
+    m = ctrl.metrics()
+    assert m["swap_rolls"] == 2 and m["swap_blackout_ms"] is not None
+    # re-ticking an adopted fleet is a no-op
+    ctrl.tick(router, now=100.0)
+    assert ctrl.swaps_completed == 1 and router.paused == ["r0", "r1"]
+
+
+def test_swap_controller_waits_for_drain_and_min_decode_steps(tmp_path):
+    ch, handles, ctrl = _controller(tmp_path, n=1, min_decode_steps=5)
+    router = _FakeRouter()
+    handles[0].decode_steps = 0
+    ch.publish_blob(_payload_body(_params_tree(seed=4)))
+    ctrl.tick(router, now=0.0)  # stages
+    ctrl.tick(router, now=1.0)
+    assert router.paused == []  # not provably mid-stream yet
+    handles[0].decode_steps = 5
+    handles[0].resident = 2
+    ctrl.tick(router, now=2.0)
+    assert router.paused == ["r0"]
+    ctrl.tick(router, now=3.0)
+    assert handles[0].engine.installs == []  # residents still draining
+    handles[0].resident = 0
+    ctrl.tick(router, now=4.0)
+    assert handles[0].engine.installs and router.resumed == ["r0"]
+
+
+def test_swap_controller_skips_unstageable_version(tmp_path):
+    ch, handles, ctrl = _controller(tmp_path, n=1)
+    router = _FakeRouter()
+    ch.publish_blob(serialization.msgpack_serialize({"not": "a ckpt"}))
+    for t in range(4):
+        ctrl.tick(router, now=float(t))
+    # staging failed once, the version is quarantined, the fleet stays up
+    assert ctrl.current_version == "v0" and not ctrl.pending()
+    assert router.paused == [] and handles[0].engine.installs == []
+    good = ch.publish_blob(_payload_body(_params_tree(seed=5)))
+    _tick_until_adopted(ctrl, router, start=10.0)
+    assert ctrl.current_version == good
+
+
+def test_swap_controller_kill_during_swap_aborts_then_completes(tmp_path):
+    ch, handles, ctrl = _controller(tmp_path, n=1)
+    router = _FakeRouter()
+    version = ch.publish_blob(_payload_body(_params_tree(seed=6)))
+    chaos.install(chaos.ChaosPlan(
+        [chaos.Fault("kill-during-swap", at="pre-install", nth=1)]
+    ))
+    try:
+        ctrl.tick(router, now=0.0)  # stage + pause
+        ctrl.tick(router, now=1.0)  # drained -> chaos aborts pre-install
+        assert ctrl.swap_aborts == 1
+        assert handles[0].engine.installs == []
+        assert router.resumed == ["r0"]  # released un-swapped
+        assert ctrl.pending()  # the staged version is still owed
+        _tick_until_adopted(ctrl, router)
+    finally:
+        chaos.uninstall()
+    assert ctrl.current_version == version
+    assert handles[0].engine.installs == [version]
+    assert ctrl.swaps_completed == 1
+
+
+def test_swap_controller_skips_dead_replica_mid_roll(tmp_path):
+    ch, handles, ctrl = _controller(tmp_path, n=2)
+    router = _FakeRouter()
+    version = ch.publish_blob(_payload_body(_params_tree(seed=7)))
+    handles[0].state = lambda: "dead"
+    _tick_until_adopted(ctrl, router)
+    # the dead replica is skipped (its journal replays elsewhere); the
+    # live one still rolls and the fleet adopts the version
+    assert handles[0].engine.installs == []
+    assert handles[1].engine.installs == [version]
+    assert ctrl.current_version == version
+
+
+# ---------------------------------------------------------------------------
+# trainer wiring: Trainer(publish_dir=...) publishes every LATEST save
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_publish_dir_publishes_each_epoch(tmp_path, mesh_1d):
+    """The `--publish-dir` train flag (Trainer publish_dir kwarg) commits
+    one channel version per epoch, and the published payload restores to
+    the trainer's live params bit-exactly."""
+    import jax
+    import optax
+
+    import distributed_pytorch_example_tpu as dpx
+    from distributed_pytorch_example_tpu.data.synthetic import _ArrayDataset
+    from distributed_pytorch_example_tpu.models.mlp import SimpleNet
+    from distributed_pytorch_example_tpu.train import ClassificationTask, Trainer
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 16)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    loader = dpx.data.DeviceLoader(
+        _ArrayDataset({"x": x, "y": y}), 32, mesh=mesh_1d, seed=0
+    )
+    trainer = Trainer(
+        SimpleNet(input_size=16, hidden_size=8, num_classes=2),
+        ClassificationTask(),
+        optax.adam(1e-2),
+        partitioner=dpx.parallel.data_parallel(mesh_1d),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        publish_dir=str(tmp_path / "pub"),
+        log_every=100,
+    )
+    trainer.fit(loader, epochs=2)
+
+    ch = PublishChannel(str(tmp_path / "pub"))
+    assert ch.versions() == ["00000001", "00000002"]
+    assert ch.latest() == "00000002"
+    restored = restore_params(
+        ch.read("00000002"),
+        jax.tree_util.tree_map(np.asarray, trainer.state.params),
+    )
+    live, pub = jax.tree_util.tree_leaves(
+        trainer.state.params
+    ), jax.tree_util.tree_leaves(restored)
+    for a, b in zip(live, pub):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# real engine: publish -> restore -> install token-exactness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_publish_restore_install_token_exact(tmp_path, devices):
+    """Weights published by the channel, restored over the exact
+    transport, and installed into a live engine must serve the same
+    tokens as a fresh engine BUILT with those weights."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_pytorch_example_tpu.models.gpt2 import GPT2
+    from distributed_pytorch_example_tpu.serving import (
+        InferenceEngine, Request,
+    )
+
+    kw = dict(vocab_size=61, max_len=32, model_dim=16, num_layers=1,
+              num_heads=2, mlp_dim=32)
+    pool = dict(paged_num_blocks=16, paged_block_size=4,
+                paged_max_blocks=4)
+    model = GPT2(**kw, decode=True, **pool)
+    v0 = GPT2(**kw).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    tuned = GPT2(**kw).init(
+        jax.random.key(9), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    rng = np.random.default_rng(0)
+    requests = [
+        Request(
+            rid=f"q{i}", prompt=[int(t) for t in rng.integers(0, 61, 6)],
+            max_new_tokens=8, seed=500 + i,
+        )
+        for i in range(6)
+    ]
+
+    swapped = InferenceEngine(model, v0, num_slots=3, temperature=0.0)
+    swapped.run(requests)  # warm + proves it serves v0 first
+    version = PublishChannel(str(tmp_path / "chan")).publish_blob(
+        serialization.msgpack_serialize({
+            "state": {"params": serialization.to_state_dict(
+                jax.tree_util.tree_map(np.asarray, tuned)
+            )},
+            "epoch": 2, "loss": 0.1, "extra": {},
+        })
+    )
+    body = PublishChannel(str(tmp_path / "chan")).read(version)
+    params, meta = restore_params(body, swapped.params, transport="exact")
+    assert meta["epoch"] == 2
+    swapped.install_params(params, version)
+    assert swapped.weights_version == version
+
+    reference = InferenceEngine(model, tuned, num_slots=3, temperature=0.0)
+    got = swapped.run(requests)["results"]
+    want = reference.run(requests)["results"]
+    for r in requests:
+        assert got[r.rid]["tokens"] == want[r.rid]["tokens"], r.rid
+        assert got[r.rid]["status"] == "done"

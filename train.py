@@ -438,6 +438,7 @@ def main():
         max_bad_steps=args.max_bad_steps,
         skip_nonfinite=not args.no_skip_nonfinite,
         checkpoint_retain=args.checkpoint_retain,
+        publish_dir=args.publish_dir,
     )
     try:
         trainer.fit(
